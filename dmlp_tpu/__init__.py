@@ -1,0 +1,44 @@
+"""dmlp_tpu — a TPU-native distributed machine-learning framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference
+``Distributed-Machine-Learning-Project`` (a 2-node MPI program: a distributed
+brute-force k-nearest-neighbors classifier on a 2D Cartesian process grid,
+validated by order-sensitive FNV-1a checksums and wall-clock benchmarks;
+see ``/root/reference/engine.cpp``, ``common.cpp``, ``run_bench.sh``).
+
+Instead of translating the MPI choreography (Cart grids, Scatterv/Bcast/Gather),
+the framework expresses the same computation TPU-first:
+
+- the brute-force distance computation (reference ``engine.cpp:12-18,239-246``,
+  a scalar O(Q*N*A) loop) becomes one MXU matmul via
+  ``|q - d|^2 = |q|^2 + |d|^2 - 2 q.d``  (:mod:`dmlp_tpu.ops.distance`);
+- the 2D process grid + row/col sub-communicators (``engine.cpp:40-57``)
+  become a ``jax.sharding.Mesh(("data", "query"))`` with ``shard_map``
+  (:mod:`dmlp_tpu.engine.sharded`);
+- the partial-top-k + root merge (``engine.cpp:249-256,289-308``) becomes
+  either an ``all_gather``-merge or a ring ``ppermute`` stream with a running
+  top-k (:mod:`dmlp_tpu.engine.ring`) — the long-context analog;
+- the checksum/report contract (``common.cpp:57-79``) is reproduced exactly
+  (:mod:`dmlp_tpu.io.checksum`, :mod:`dmlp_tpu.io.report`);
+- the training north star (data-parallel ``train_step`` with ``psum`` gradient
+  sync, samples/sec/chip + MFU metrics) lives in :mod:`dmlp_tpu.train`.
+
+Package layout::
+
+    dmlp_tpu/
+      io/        input grammar, checksum, report, seeded data generation,
+                 native (C++) host parser bindings
+      golden/    pure-NumPy oracle (portable replacement for the x86
+                 benchmark binaries, which cannot run here)
+      ops/       distance / top-k / vote kernels (+ pallas/ TPU kernels)
+      engine/    single-chip, 2D-sharded, and ring-streaming KNN engines
+      parallel/  mesh construction, collective helpers, multi-host init
+      models/    KNN model facade + MLP classifier (training extension)
+      train/     jitted train_step (DP psum / TP sharding), metrics, checkpoint
+      utils/     timing (the "Time taken:" contract), profiling, logging
+      bench/     benchmark harness (run_bench.sh equivalent)
+"""
+
+__version__ = "0.1.0"
+
+from dmlp_tpu.config import EngineConfig  # noqa: F401

@@ -1,0 +1,101 @@
+"""Drop-in engine CLI: reads the input grammar on stdin, writes results.
+
+The TPU-native equivalent of ``mpirun ./engine < input`` (reference
+common.cpp:81-135 + run_bench.sh:82-84): stdout carries per-query results
+(checksums, or the -DDEBUG listing with ``--debug``), stderr carries the
+``Time taken: <ms> ms`` contract line. No mpirun: one process drives the
+device mesh.
+
+Usage::
+
+    python -m dmlp_tpu [--mode single|sharded|ring] [--debug] [--fast]
+                       [--engine jax|golden] [--phase-times] < input.in
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import IO, Optional, Sequence
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.io.grammar import parse_input
+from dmlp_tpu.io.report import format_results
+from dmlp_tpu.utils.timing import EngineTimer
+
+
+def make_engine(config: EngineConfig):
+    """Engine registry (lazy imports keep CLI start light)."""
+    if config.mode == "single":
+        from dmlp_tpu.engine.single import SingleChipEngine
+        return SingleChipEngine(config)
+    if config.mode == "sharded":
+        from dmlp_tpu.engine.sharded import ShardedEngine
+        return ShardedEngine(config)
+    if config.mode == "ring":
+        from dmlp_tpu.engine.ring import RingEngine
+        return RingEngine(config)
+    raise ValueError(f"unknown mode {config.mode!r}")
+
+
+def main(argv: Optional[Sequence[str]] = None,
+         stdin: Optional[IO] = None,
+         stdout: Optional[IO] = None,
+         stderr: Optional[IO] = None) -> int:
+    parser = argparse.ArgumentParser(prog="dmlp_tpu", description=__doc__)
+    parser.add_argument("--mode", default="single",
+                        choices=["single", "sharded", "ring"])
+    parser.add_argument("--engine", default="jax", choices=["jax", "golden"],
+                        help="'golden' runs the NumPy oracle (differential "
+                             "testing reference)")
+    parser.add_argument("--debug", action="store_true",
+                        help="human-readable output (the -DDEBUG build)")
+    parser.add_argument("--fast", action="store_true",
+                        help="skip the float64 host rescore (f32 ordering)")
+    parser.add_argument("--device-full", action="store_true",
+                        help="vote + report ordering on device too")
+    parser.add_argument("--data-block", type=int, default=2048)
+    parser.add_argument("--query-block", type=int, default=1024)
+    parser.add_argument("--dtype", default="float32",
+                        choices=["float32", "bfloat16"])
+    parser.add_argument("--phase-times", action="store_true",
+                        help="per-phase ms breakdown on stderr (extension)")
+    args = parser.parse_args(argv)
+
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    stderr = stderr or sys.stderr
+
+    config = EngineConfig(mode=args.mode, debug=args.debug,
+                          exact=not args.fast, data_block=args.data_block,
+                          query_block=args.query_block, dtype=args.dtype)
+
+    timer = EngineTimer()
+    with timer.phase("parse"):
+        inp = parse_input(stdin)
+
+    # Only the solve is timed, matching the reference's timed region
+    # (common.cpp:122-131 brackets Engine::KNN after ingest).
+    timer.start()
+    if args.engine == "golden":
+        from dmlp_tpu.golden.reference import knn_golden
+        results = knn_golden(inp)
+    else:
+        engine = make_engine(config)
+        if args.device_full:
+            results = engine.run_device_full(inp)
+        else:
+            results = engine.run(inp)
+    text = format_results(results, debug=config.debug)
+    timer.stop()
+
+    stdout.write(text)
+    stderr.write(timer.stderr_line())
+    if args.phase_times:
+        for name, ms in timer.phase_ms.items():
+            stderr.write(f"phase {name}: {ms:.1f} ms\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""Engine configuration.
+
+The reference spreads configuration over three mechanisms (survey §5.6):
+compile-time ``-DDEBUG`` (Makefile:14), the generator's argparse CLI
+(generate_input.py:27-40), and hardcoded SLURM configs in run_bench.sh:77-162.
+Here everything is one dataclass; problem-size parameters still travel in-band
+as the input header (common.cpp:12-15), exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Configuration for the KNN engines.
+
+    Attributes:
+      mode: "single" | "sharded" | "ring" — which engine to run.
+        "single" is the one-chip engine; "sharded" is the 2D-mesh
+        all-gather-merge engine (analog of the reference's grid +
+        MPI_Gather merge, engine.cpp:40-57,282-308); "ring" streams data
+        shards around the mesh ring with a running top-k (the
+        long-context / memory-bounded variant).
+      mesh_shape: (data_axis_size, query_axis_size). None = auto from
+        available devices (mirrors MPI_Dims_create at engine.cpp:41).
+      data_block: data points processed per inner step on one chip.
+        Bounds the live distance-tile to query_block x data_block.
+      query_block: queries processed per outer step.
+      dtype: on-device distance dtype ("float32" or "bfloat16").
+        The reference computes in float64 (engine.cpp:12); TPU MXU is
+        f32/bf16, so strict-parity runs add host rescoring (``exact``).
+      exact: if True, rescore the top-(k+margin) candidates on host in
+        float64 and re-select — restores float64 ordering (and hence
+        checksum parity with the golden model) while keeping the O(Q*N*A)
+        work on the MXU.
+      margin: extra candidates (beyond max-k) carried to the host rescore.
+      debug: human-readable output instead of checksums — the -DDEBUG
+        build of the reference (common.cpp:72-78).
+      use_pallas: use the fused Pallas distance kernel where available.
+    """
+
+    mode: str = "single"
+    mesh_shape: Optional[Tuple[int, int]] = None
+    data_block: int = 2048
+    query_block: int = 1024
+    dtype: str = "float32"
+    exact: bool = True
+    margin: int = 16
+    debug: bool = False
+    use_pallas: bool = False
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("single", "sharded", "ring"):
+            raise ValueError(f"unknown mode {self.mode!r}")
+        if self.dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"unsupported dtype {self.dtype!r}")
+        if self.data_block <= 0 or self.query_block <= 0:
+            raise ValueError("block sizes must be positive")
+        if self.margin < 0:
+            raise ValueError("margin must be >= 0")

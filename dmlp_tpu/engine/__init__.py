@@ -1,0 +1,2 @@
+from dmlp_tpu.engine.single import SingleChipEngine  # noqa: F401
+from dmlp_tpu.engine.finalize import finalize_host  # noqa: F401

@@ -1,0 +1,121 @@
+"""Host-side result finalization shared by all engines.
+
+The device engines return, per query, a selection-ordered candidate list of
+size K >= max-k (+ margin). This module turns those lists into final
+``QueryResult``s: optional exact float64 rescoring (restoring the reference's
+double-precision ordering, engine.cpp:12 / common.h:13, without paying f64 on
+the MXU), the per-query k cut, the majority vote (engine.cpp:320-332), the
+report sort (engine.cpp:334-338), and -1-sentinel padding (common.cpp:66).
+
+Everything is vectorized NumPy over (Q, K) arrays — K is small (tens), so
+this is a negligible epilogue next to the O(Q*N*A) device work.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from dmlp_tpu.io.report import QueryResult
+
+
+def _row_lexsort(primary: np.ndarray, *descending_ints: np.ndarray) -> np.ndarray:
+    """Row-wise argsort by (primary asc, then each int key desc), stable.
+
+    Implemented as composed stable sorts, least-significant key first (the
+    radix trick), all vectorized along axis 1.
+    """
+    idx = np.broadcast_to(np.arange(primary.shape[1]), primary.shape).copy()
+    keys = [(-k).astype(np.int64) for k in reversed(descending_ints)] + [primary]
+    for key in keys:  # least-significant first; stable sorts compose
+        cur = np.take_along_axis(key, idx, axis=1)
+        order = np.argsort(cur, axis=1, kind="stable")
+        idx = np.take_along_axis(idx, order, axis=1)
+    return idx
+
+
+def _vote_batch(labels: np.ndarray, valid: np.ndarray) -> np.ndarray:
+    """Vectorized majority vote with tie -> larger label; -1 if none valid."""
+    q = labels.shape[0]
+    masked = np.where(valid, labels, -1)
+    num_labels = int(masked.max()) + 1 if masked.size and masked.max() >= 0 else 0
+    if num_labels == 0:
+        return np.full(q, -1, np.int64)
+    counts = np.zeros((q, num_labels), np.int64)
+    rows = np.broadcast_to(np.arange(q)[:, None], labels.shape)
+    sel = masked >= 0
+    np.add.at(counts, (rows[sel], masked[sel]), 1)
+    best = counts.max(axis=1)
+    is_best = counts == best[:, None]
+    predicted = num_labels - 1 - np.argmax(is_best[:, ::-1], axis=1)
+    return np.where(best > 0, predicted, -1)
+
+
+def rescore_f64(cand_ids: np.ndarray, query_attrs: np.ndarray,
+                data_attrs: np.ndarray, block: int = 1024) -> np.ndarray:
+    """Exact float64 distances for candidate ids (difference form, like
+    computeDistance at engine.cpp:12-18). ids < 0 map to +inf."""
+    q, k = cand_ids.shape
+    out = np.empty((q, k), np.float64)
+    safe = np.clip(cand_ids, 0, data_attrs.shape[0] - 1)
+    for q0 in range(0, q, block):
+        q1 = min(q0 + block, q)
+        gathered = data_attrs[safe[q0:q1]]                       # (b, K, A)
+        diff = gathered - query_attrs[q0:q1, None, :]
+        out[q0:q1] = np.einsum("qka,qka->qk", diff, diff)
+    out[cand_ids < 0] = np.inf
+    return out
+
+
+def finalize_host(cand_dists: np.ndarray, cand_labels: np.ndarray,
+                  cand_ids: np.ndarray, ks: np.ndarray,
+                  query_attrs: np.ndarray, data_attrs: np.ndarray,
+                  exact: bool = True,
+                  query_ids: np.ndarray | None = None) -> List[QueryResult]:
+    """Candidate lists -> final per-query results.
+
+    Args:
+      cand_dists/labels/ids: (Q, K) device candidate lists (selection order).
+      ks: (Q,) per-query k (K >= ks.max() required).
+      query_attrs/data_attrs: float64 originals, used only when ``exact``.
+      exact: rescore candidates in float64 and re-select (parity mode).
+      query_ids: (Q,) global query ids; defaults to arange (single process).
+    """
+    q, kcap = cand_ids.shape
+    ks = np.asarray(ks, np.int64)
+    if q and kcap < ks.max():
+        raise ValueError(f"candidate width {kcap} < max k {ks.max()}")
+    cand_ids = np.asarray(cand_ids, np.int64)
+    cand_labels = np.asarray(cand_labels, np.int64)
+    d = rescore_f64(cand_ids, query_attrs, data_attrs) if exact \
+        else np.asarray(cand_dists, np.float64)
+
+    # Re-derive the selection order (dist asc, label desc, id desc); after
+    # float64 rescoring the device's f32 order may no longer be sorted.
+    order = _row_lexsort(d, cand_labels, cand_ids)
+    d = np.take_along_axis(d, order, axis=1)
+    labels = np.take_along_axis(cand_labels, order, axis=1)
+    ids = np.take_along_axis(cand_ids, order, axis=1)
+
+    pos = np.arange(kcap)[None, :]
+    in_k = pos < ks[:, None]
+    valid = in_k & (ids >= 0)
+    predicted = _vote_batch(labels, valid)
+
+    # Report order (dist asc, id desc) over the first-k entries; slots at or
+    # beyond k (and sentinel padding) are (inf, -1) and sort last.
+    rd = np.where(valid, d, np.inf)
+    rids = np.where(valid, ids, -1)
+    ro = _row_lexsort(rd, rids)
+    rd = np.take_along_axis(rd, ro, axis=1)
+    rids = np.take_along_axis(rids, ro, axis=1)
+
+    if query_ids is None:
+        query_ids = np.arange(q, dtype=np.int64)
+    results: List[QueryResult] = []
+    for qi in range(q):
+        k = int(ks[qi])
+        results.append(QueryResult(int(query_ids[qi]), k, int(predicted[qi]),
+                                   rids[qi, :k].copy(), rd[qi, :k].copy()))
+    return results

@@ -1,0 +1,150 @@
+"""Single-chip KNN engine — the minimum end-to-end slice (survey §7 L1).
+
+One jitted function does what the reference's whole MPI choreography does on
+a grid of CPU ranks (engine.cpp:20-351): distances ride the MXU as a matmul
+(dmlp_tpu.ops.distance), selection is an exact-tie-break sort
+(dmlp_tpu.ops.topk), and queries/data stream in blocks so the (Q, N) distance
+matrix never materializes. The scatter/bcast phases (engine.cpp:62-209)
+vanish: one chip holds the (padded) arrays in HBM.
+
+Two output paths:
+
+- ``candidates()`` + host finalize (default, ``run()``): the device returns
+  top-(kmax + margin) candidate lists; the host rescores them in float64 and
+  applies vote/report semantics — checksum parity with the float64 golden
+  model while the MXU does the O(Q*N*A) work in f32/bf16.
+- ``run_device_full()``: vote + report ordering on-device too (benchmark
+  path; no float64 rescue).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.engine.finalize import finalize_host
+from dmlp_tpu.io.grammar import KNNInput
+from dmlp_tpu.io.report import QueryResult
+from dmlp_tpu.ops.topk import TopK, streaming_topk
+from dmlp_tpu.ops.vote import majority_vote, report_order
+
+
+def round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def pad_dataset(inp: KNNInput, multiple: int, dtype: np.dtype
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad (attrs, labels, ids) to a multiple of ``multiple`` rows.
+
+    Sentinel rows carry label = -1 and id = -1; the distance kernel masks
+    them to +inf (masked_pairwise_sq_l2). This replaces the reference's
+    uneven remainder shards (engine.cpp:62-63) — XLA wants static, uniform
+    shapes.
+    """
+    n = inp.params.num_data
+    npad = round_up(max(n, 1), multiple)
+    attrs = np.zeros((npad, inp.params.num_attrs), dtype)
+    attrs[:n] = inp.data_attrs
+    labels = np.full(npad, -1, np.int32)
+    labels[:n] = inp.labels
+    ids = np.full(npad, -1, np.int32)
+    ids[:n] = np.arange(n, dtype=np.int32)
+    return attrs, labels, ids
+
+
+@functools.partial(jax.jit, static_argnames=("k", "data_block"))
+def _topk_block(data_attrs, data_labels, data_ids, q_attrs, *, k, data_block):
+    return streaming_topk(q_attrs, data_attrs, data_labels, data_ids,
+                          k=k, data_block=data_block)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "data_block", "num_labels"))
+def _full_block(data_attrs, data_labels, data_ids, q_attrs, ks, *,
+                k, data_block, num_labels):
+    top = streaming_topk(q_attrs, data_attrs, data_labels, data_ids,
+                         k=k, data_block=data_block)
+    rd, rids, in_k = report_order(top, ks)
+    valid = in_k & (top.ids >= 0)
+    predicted = majority_vote(top.labels, valid, num_labels)
+    return predicted, rids, rd
+
+
+class SingleChipEngine:
+    """The one-chip engine (CPU backend in CI, TPU in production)."""
+
+    def __init__(self, config: EngineConfig = EngineConfig()):
+        self.config = config
+        self._dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+
+    def _prep(self, inp: KNNInput):
+        cfg = self.config
+        n = inp.params.num_data
+        data_block = min(cfg.data_block, round_up(max(n, 1), 8))
+        attrs, labels, ids = pad_dataset(inp, data_block, np.float64)
+        kmax = int(inp.ks.max()) if inp.params.num_queries else 1
+        extra = cfg.margin if cfg.exact else 0
+        k = min(round_up(kmax + extra, 8), attrs.shape[0])
+        k = max(k, kmax)  # never below the widest query's k
+        d_attrs = jnp.asarray(attrs, self._dtype)
+        return d_attrs, jnp.asarray(labels), jnp.asarray(ids), k, data_block
+
+    def candidates(self, inp: KNNInput) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Device pass: (Q, K) selection-ordered candidate lists as NumPy."""
+        cfg = self.config
+        d_attrs, d_labels, d_ids, k, data_block = self._prep(inp)
+        nq = inp.params.num_queries
+        qb = min(cfg.query_block, round_up(max(nq, 1), 8))
+        qpad = round_up(max(nq, 1), qb)
+        q_attrs = np.zeros((qpad, inp.params.num_attrs), np.float64)
+        q_attrs[:nq] = inp.query_attrs
+
+        outs: List[TopK] = []
+        for q0 in range(0, qpad, qb):
+            blk = jnp.asarray(q_attrs[q0:q0 + qb], self._dtype)
+            outs.append(_topk_block(d_attrs, d_labels, d_ids, blk,
+                                    k=k, data_block=data_block))
+        dists = np.concatenate([np.asarray(o.dists, np.float64) for o in outs])[:nq]
+        labels = np.concatenate([np.asarray(o.labels) for o in outs])[:nq]
+        ids = np.concatenate([np.asarray(o.ids) for o in outs])[:nq]
+        return dists, labels, ids
+
+    def run(self, inp: KNNInput) -> List[QueryResult]:
+        """Full parity pipeline: device candidates + host float64 finalize."""
+        dists, labels, ids = self.candidates(inp)
+        return finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
+                             inp.data_attrs, exact=self.config.exact)
+
+    def run_device_full(self, inp: KNNInput) -> List[QueryResult]:
+        """All-device pipeline (vote + report order on TPU); f32 ordering."""
+        cfg = self.config
+        d_attrs, d_labels, d_ids, k, data_block = self._prep(inp)
+        nq = inp.params.num_queries
+        num_labels = int(inp.labels.max()) + 1 if inp.params.num_data else 1
+        qb = min(cfg.query_block, round_up(max(nq, 1), 8))
+        qpad = round_up(max(nq, 1), qb)
+        q_attrs = np.zeros((qpad, inp.params.num_attrs), np.float64)
+        q_attrs[:nq] = inp.query_attrs
+        ks_pad = np.zeros(qpad, np.int32)
+        ks_pad[:nq] = inp.ks
+
+        preds, rids, rd = [], [], []
+        for q0 in range(0, qpad, qb):
+            p, i, d = _full_block(
+                d_attrs, d_labels, d_ids,
+                jnp.asarray(q_attrs[q0:q0 + qb], self._dtype),
+                jnp.asarray(ks_pad[q0:q0 + qb]),
+                k=k, data_block=data_block, num_labels=num_labels)
+            preds.append(np.asarray(p)); rids.append(np.asarray(i)); rd.append(np.asarray(d, np.float64))
+        preds = np.concatenate(preds)[:nq]
+        rids = np.concatenate(rids)[:nq]
+        rd = np.concatenate(rd)[:nq]
+        return [QueryResult(qi, int(inp.ks[qi]), int(preds[qi]),
+                            rids[qi, : int(inp.ks[qi])].astype(np.int64),
+                            rd[qi, : int(inp.ks[qi])])
+                for qi in range(nq)]

@@ -1,0 +1,1 @@
+from dmlp_tpu.golden.reference import knn_golden, solve_text  # noqa: F401

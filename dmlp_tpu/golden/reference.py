@@ -1,0 +1,104 @@
+"""Pure-NumPy golden KNN model — the portable differential-testing oracle.
+
+The reference verifies engines against four stripped x86/MPI oracle binaries
+(benchmarks/bench_1..4, survey §4); those cannot execute in a TPU-host image
+(no orted — verified), so this module is the portable oracle every engine is
+diffed against, implementing the *intended* semantics of engine.cpp exactly:
+
+- squared Euclidean distance, float64, difference form (engine.cpp:12-18);
+- k-selection comparator: distance asc, tie -> **larger label** first
+  (engine.cpp:251-254 and the identical merge comparator at :302-305);
+- majority vote over the selected k with tie -> **larger label**
+  (engine.cpp:326-332);
+- report order: distance asc, tie -> **larger id** first (engine.cpp:334-338);
+- pad with the id = -1 sentinel when fewer than k candidates exist
+  (common.cpp:66); padded entries carry dist = +inf and do not vote.
+
+Deterministic refinement: the C++ selection comparator does not inspect ids,
+so candidates equal in (distance, label) across the k-boundary are chosen
+unspecifiedly by ``std::nth_element``. This oracle (and every engine in this
+framework) refines the order to (distance asc, label desc, **id desc**) — a
+strict total order, which also makes blockwise top-k + merge exactly equal to
+the global top-k (the property the sharded/ring engines rely on). Known
+defects of the author's engine are deliberately not inherited (survey §7
+quirks Q1-Q3: wrong merge offsets for heterogeneous k, zero-padding of short
+shards, duplicated report loop).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from dmlp_tpu.io.grammar import KNNInput, parse_input_text
+from dmlp_tpu.io.report import QueryResult, format_results
+
+
+def _select_order(dists: np.ndarray, labels: np.ndarray, ids: np.ndarray) -> np.ndarray:
+    """Indices sorting by the selection total order (dist asc, label desc, id desc)."""
+    return np.lexsort((-ids, -labels, dists))
+
+
+def vote(labels: np.ndarray) -> int:
+    """Majority vote with tie -> larger label (engine.cpp:320-332).
+
+    Returns -1 for an empty candidate set (the C++ ``predicted_label``
+    initializer at engine.cpp:326).
+    """
+    if labels.size == 0:
+        return -1
+    uniq, counts = np.unique(labels, return_counts=True)
+    best = counts.max()
+    return int(uniq[counts == best].max())
+
+
+def knn_golden(inp: KNNInput, dtype=np.float64,
+               query_block: int = 256) -> List[QueryResult]:
+    """Solve a problem instance exactly; returns per-query results in id order.
+
+    ``dtype`` controls the distance arithmetic (float64 = reference parity;
+    float32 mirrors the on-device engines for like-for-like differential
+    tests). Queries are processed in blocks so the (Q, N) distance matrix is
+    never fully materialized.
+    """
+    nd = inp.params.num_data
+    nq = inp.params.num_queries
+    data = inp.data_attrs.astype(dtype)
+    queries = inp.query_attrs.astype(dtype)
+    labels = inp.labels.astype(np.int64)
+    ids = np.arange(nd, dtype=np.int64)
+
+    results: List[QueryResult] = []
+    for q0 in range(0, nq, query_block):
+        q1 = min(q0 + query_block, nq)
+        # Difference form, like computeDistance (engine.cpp:12-18) — exact in
+        # the working dtype, unlike the norm+matmul form the device uses.
+        diff = queries[q0:q1, None, :] - data[None, :, :]
+        dists = np.einsum("qna,qna->qn", diff, diff)
+        for qi in range(q0, q1):
+            k = int(inp.ks[qi])
+            drow = dists[qi - q0]
+            order = _select_order(drow, labels, ids)[: min(k, nd)]
+            sel_d, sel_l, sel_i = drow[order], labels[order], ids[order]
+            predicted = vote(sel_l)
+            # Report order: dist asc, tie -> larger id (engine.cpp:334-338).
+            ro = np.lexsort((-sel_i, sel_d))
+            out_ids = sel_i[ro]
+            out_dists = sel_d[ro]
+            if out_ids.size < k:  # id=-1 sentinel padding (common.cpp:66)
+                pad = k - out_ids.size
+                out_ids = np.concatenate([out_ids, np.full(pad, -1, np.int64)])
+                out_dists = np.concatenate([out_dists, np.full(pad, np.inf)])
+            results.append(QueryResult(qi, k, predicted,
+                                       out_ids.astype(np.int64),
+                                       out_dists.astype(np.float64)))
+    return results
+
+
+def solve_text(text: str, dtype=np.float64, debug: bool = False,
+               inp: Optional[KNNInput] = None) -> str:
+    """End-to-end oracle: input grammar text -> stdout channel text."""
+    if inp is None:
+        inp = parse_input_text(text)
+    return format_results(knn_golden(inp, dtype=dtype), debug=debug)

@@ -1,0 +1,13 @@
+"""I/O layer: the reference's harness contract (grammar, checksum, report)."""
+
+from dmlp_tpu.io.grammar import (  # noqa: F401
+    Params,
+    KNNInput,
+    parse_input,
+    parse_input_text,
+    format_input,
+    parse_update,
+)
+from dmlp_tpu.io.checksum import fnv1a_checksum, FNV_BASIS, FNV_PRIME  # noqa: F401
+from dmlp_tpu.io.report import format_results, QueryResult  # noqa: F401
+from dmlp_tpu.io.datagen import generate_input_text  # noqa: F401

@@ -1,0 +1,155 @@
+"""The stdin input grammar of the reference harness, as array-producing parsers.
+
+Grammar (reference common.cpp:12-55, generate_input.py:11-21)::
+
+    line 0:                "num_data num_queries num_attrs"
+    lines 1..num_data:     "label a1 a2 ... aA"          (one data point each)
+    next num_queries:      "Q k a1 a2 ... aA"            (one query each)
+
+Data-point ids are implicit line order (gid, common.cpp:103), query ids are
+implicit order among query lines (common.cpp:110). Tokens are whitespace
+separated; attribute values are decimals (the generator emits %.6f).
+
+Unlike the reference, which parses into per-record structs
+(common.h:10-20) on rank 0 only (common.cpp:93-117), we parse straight into
+flat NumPy arrays — the layout the TPU engine feeds to the device. Struct
+views are still available for tests/tools.
+
+Error behavior mirrors common.cpp:100-115: empty data line -> "Line is
+empty"; query line not starting with 'Q' -> "Line is wrongly formatted".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import IO, List, Union
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    """Problem-size header (reference common.h:4-8)."""
+
+    num_data: int
+    num_queries: int
+    num_attrs: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Update:
+    """An attribute-update record (reference common.h:22-25, common.cpp:46-55).
+
+    Present in the reference's grammar/data model but never consumed by its
+    engine; kept for full contract parity.
+    """
+
+    id: int
+    new_attrs: np.ndarray
+
+
+@dataclasses.dataclass
+class KNNInput:
+    """A fully parsed problem instance in array (SoA) form.
+
+    The reference keeps AoS vectors of structs and flattens them right before
+    each MPI scatter (engine.cpp:79-96,154-184); we keep SoA from the start —
+    ids are simply ``arange`` (implicit line order), so they are not stored.
+    """
+
+    params: Params
+    labels: np.ndarray        # (num_data,)  int32
+    data_attrs: np.ndarray    # (num_data, num_attrs)  float64
+    ks: np.ndarray            # (num_queries,)  int32
+    query_attrs: np.ndarray   # (num_queries, num_attrs)  float64
+
+    @property
+    def data_ids(self) -> np.ndarray:
+        return np.arange(self.params.num_data, dtype=np.int32)
+
+    @property
+    def query_ids(self) -> np.ndarray:
+        return np.arange(self.params.num_queries, dtype=np.int32)
+
+
+def parse_params(line: str) -> Params:
+    """Parse the header line (reference common.cpp:12-15)."""
+    toks = line.split()
+    return Params(int(toks[0]), int(toks[1]), int(toks[2]))
+
+
+def parse_update(line: str) -> Update:
+    """Parse an update line "id v1 v2 ..." (reference common.cpp:46-55)."""
+    toks = line.split()
+    return Update(int(toks[0]), np.array([float(t) for t in toks[1:]], dtype=np.float64))
+
+
+def parse_input(stream: Union[IO[str], IO[bytes]]) -> KNNInput:
+    """Parse a full problem instance from a text or binary stream."""
+    data = stream.read()
+    if isinstance(data, bytes):
+        data = data.decode("ascii")
+    return parse_input_text(data)
+
+
+def parse_input_text(text: str) -> KNNInput:
+    """Parse a full problem instance from a string.
+
+    Mirrors the rank-0 ingest loop at common.cpp:93-117, including its error
+    messages, but produces flat arrays. Uses a single bulk tokenizer pass for
+    the numeric payload instead of per-line stringstreams — the reference's
+    rank-0 ingest is its host-side bottleneck (survey §7 "host input
+    pipeline"); this parser is the pure-Python fallback for the native C++
+    one in :mod:`dmlp_tpu.io.native`.
+    """
+    lines = text.splitlines()
+    if not lines:
+        raise ValueError("empty input")
+    params = parse_params(lines[0])
+    nd, nq, na = params.num_data, params.num_queries, params.num_attrs
+    if len(lines) < 1 + nd + nq:
+        raise ValueError(
+            f"input has {len(lines) - 1} record lines, expected {nd + nq}"
+        )
+
+    labels = np.empty(nd, dtype=np.int32)
+    data_attrs = np.empty((nd, na), dtype=np.float64)
+    for i in range(nd):
+        line = lines[1 + i]
+        if not line:
+            raise ValueError("Line is empty")  # common.cpp:101
+        toks = line.split()
+        labels[i] = int(toks[0])
+        data_attrs[i] = [float(t) for t in toks[1 : 1 + na]]
+
+    ks = np.empty(nq, dtype=np.int32)
+    query_attrs = np.empty((nq, na), dtype=np.float64)
+    for i in range(nq):
+        line = lines[1 + nd + i]
+        if not line or line[0] != "Q":
+            raise ValueError("Line is wrongly formatted")  # common.cpp:114
+        toks = line[1:].split()
+        ks[i] = int(toks[0])
+        query_attrs[i] = [float(t) for t in toks[1 : 1 + na]]
+
+    return KNNInput(params, labels, data_attrs, ks, query_attrs)
+
+
+def format_input(inp: KNNInput, precision: int = 6) -> str:
+    """Serialize a problem instance back to the input grammar.
+
+    Inverse of :func:`parse_input_text`; matches generate_input.py:11-21
+    formatting (%.6f attributes) so round-trips are byte-stable for
+    generator-produced data.
+    """
+    out: List[str] = [
+        f"{inp.params.num_data} {inp.params.num_queries} {inp.params.num_attrs}"
+    ]
+    fmt = f"%.{precision}f"
+    for i in range(inp.params.num_data):
+        attrs = " ".join(fmt % v for v in inp.data_attrs[i])
+        out.append(f"{int(inp.labels[i])} {attrs}")
+    for i in range(inp.params.num_queries):
+        attrs = " ".join(fmt % v for v in inp.query_attrs[i])
+        out.append(f"Q {int(inp.ks[i])} {attrs}")
+    return "\n".join(out) + "\n"

@@ -1,0 +1,3 @@
+from dmlp_tpu.ops.distance import pairwise_sq_l2, masked_pairwise_sq_l2  # noqa: F401
+from dmlp_tpu.ops.topk import select_topk, merge_topk, streaming_topk, TopK  # noqa: F401
+from dmlp_tpu.ops.vote import majority_vote, report_order  # noqa: F401

@@ -1,0 +1,113 @@
+"""Exact-tie-break k-selection and blockwise merge.
+
+The correctness contract is order-sensitive (checksums, survey §4), and the
+reference's comparators are exotic: selection ties break to the **larger
+label** (engine.cpp:251-254), final report ties to the **larger id**
+(engine.cpp:334-338). ``jax.lax.top_k`` breaks ties by lowest index, so it
+cannot express this; instead selection is a multi-operand ``jax.lax.sort``
+over the composite key
+
+    (distance asc, label desc, id desc)
+
+— a strict total order (the id refinement makes ties deterministic where the
+C++ ``nth_element`` left them unspecified; see dmlp_tpu.golden.reference).
+Totality is what makes blockwise selection exact: top-k of a union equals
+top-k of concatenated per-block top-k's, so the same primitive implements the
+local select (engine.cpp:249-256), the root merge (engine.cpp:300-307), the
+sharded all-gather merge, and the ring running merge.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class TopK(NamedTuple):
+    """Per-query candidate lists, sorted by the selection order.
+
+    Shapes are (..., k). Padding entries carry dist=+inf, label=-1, id=-1.
+    """
+
+    dists: jax.Array   # float
+    labels: jax.Array  # int32
+    ids: jax.Array     # int32
+
+
+def select_topk(dists: jax.Array, labels: jax.Array, ids: jax.Array,
+                k: int) -> TopK:
+    """Select the k best (dist asc, label desc, id desc) along the last axis.
+
+    ``labels``/``ids`` broadcast against ``dists`` (e.g. (N,) vs (Q, N)).
+    If k exceeds the axis size, results are padded with (+inf, -1, -1).
+    """
+    labels = jnp.broadcast_to(labels, dists.shape)
+    ids = jnp.broadcast_to(ids, dists.shape)
+    n = dists.shape[-1]
+    if k > n:
+        pad = k - n
+        shape = dists.shape[:-1] + (pad,)
+        dists = jnp.concatenate(
+            [dists, jnp.full(shape, jnp.inf, dists.dtype)], axis=-1)
+        labels = jnp.concatenate(
+            [labels, jnp.full(shape, -1, labels.dtype)], axis=-1)
+        ids = jnp.concatenate([ids, jnp.full(shape, -1, ids.dtype)], axis=-1)
+    # Ascending lexicographic sort on (dist, -label, -id): exactly the
+    # selection total order. num_keys=3 keeps everything int32/f32 (no x64).
+    sd, _, _, sl, si = jax.lax.sort(
+        (dists, -labels, -ids, labels, ids), num_keys=3, dimension=-1)
+    return TopK(sd[..., :k], sl[..., :k], si[..., :k])
+
+
+def merge_topk(a: TopK, b: TopK, k: int) -> TopK:
+    """Merge two candidate lists into the k best — the root-merge analog
+    (engine.cpp:289-308), also the ring engine's running-reduction step."""
+    return select_topk(
+        jnp.concatenate([a.dists, b.dists], axis=-1),
+        jnp.concatenate([a.labels, b.labels], axis=-1),
+        jnp.concatenate([a.ids, b.ids], axis=-1),
+        k)
+
+
+def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
+                   data_labels: jax.Array, data_ids: jax.Array, k: int,
+                   data_block: int, accum_dtype=jnp.float32) -> TopK:
+    """Top-k nearest data points per query, streaming over data blocks.
+
+    Computes (Qb x data_block) distance tiles one block at a time and folds
+    each into a running top-k, so peak memory is O(Qb * (data_block + k))
+    instead of O(Qb * N) — the blockwise-partial-reduce shape the reference
+    implements across ranks (survey §5.7), here as a ``lax.scan`` on one chip
+    (and reused per-shard by the distributed engines).
+
+    ``data_attrs`` must be padded to a multiple of ``data_block`` with
+    sentinel rows (id = -1); real N may be smaller.
+    """
+    from dmlp_tpu.ops.distance import masked_pairwise_sq_l2
+
+    n = data_attrs.shape[0]
+    assert n % data_block == 0, "pad data to a multiple of data_block first"
+    nblocks = n // data_block
+    qb = query_attrs.shape[0]
+
+    blocks = (data_attrs.reshape(nblocks, data_block, -1),
+              data_labels.reshape(nblocks, data_block),
+              data_ids.reshape(nblocks, data_block))
+
+    init = TopK(
+        jnp.full((qb, k), jnp.inf, accum_dtype),
+        jnp.full((qb, k), -1, jnp.int32),
+        jnp.full((qb, k), -1, jnp.int32))
+
+    def step(carry: TopK, blk):
+        battrs, blabels, bids = blk
+        tile = masked_pairwise_sq_l2(query_attrs, battrs, bids, accum_dtype)
+        cand = TopK(tile,
+                    jnp.broadcast_to(blabels[None, :], tile.shape),
+                    jnp.broadcast_to(bids[None, :], tile.shape))
+        return merge_topk(carry, cand, k), None
+
+    out, _ = jax.lax.scan(step, init, blocks)
+    return out

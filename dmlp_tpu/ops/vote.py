@@ -1,0 +1,59 @@
+"""Device-side majority vote and report ordering.
+
+Mirrors the reference's result post-processing (engine.cpp:314-347): a
+majority label vote with tie -> larger label (:320-332) and the final
+(distance asc, tie -> larger id) report sort (:334-338), both as jittable
+batched ops so the full pipeline can stay on-device (the CLI parity path
+instead finalizes on host in float64 — see dmlp_tpu.engine.single).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dmlp_tpu.ops.topk import TopK
+
+
+def majority_vote(labels: jax.Array, valid: jax.Array,
+                  num_labels: int) -> jax.Array:
+    """Majority vote per query with tie -> larger label.
+
+    Args:
+      labels: (Q, K) candidate labels (selection-ordered top-k lists).
+      valid: (Q, K) bool — which candidates participate (first k_q real
+        entries; padding/sentinel entries are False).
+      num_labels: static upper bound (all labels < num_labels).
+
+    Returns:
+      (Q,) int32 predicted labels; -1 where no candidate is valid
+      (the C++ initializer at engine.cpp:326).
+    """
+    onehot = jax.nn.one_hot(labels, num_labels, dtype=jnp.int32)
+    counts = jnp.sum(onehot * valid[..., None].astype(jnp.int32), axis=-2)
+    # argmax on the label-reversed counts finds, among maximal counts, the
+    # largest label (argmax returns the first maximum).
+    rev = counts[..., ::-1]
+    predicted = num_labels - 1 - jnp.argmax(rev, axis=-1).astype(jnp.int32)
+    any_valid = jnp.max(counts, axis=-1) > 0
+    return jnp.where(any_valid, predicted, -1)
+
+
+def report_order(topk: TopK, ks: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Mask each query's list to its own k and sort for reporting.
+
+    ``topk`` lists are selection-ordered (dist asc, label desc, id desc), so
+    the first k_q entries *are* query q's top-k_q; entries beyond k_q are
+    invalidated (dist=+inf, id=-1) and the list re-sorted by the report order
+    (dist asc, id desc). Returns (dists, ids, valid) with valid marking the
+    first k_q slots of the report — the slots ``reportResult`` would print
+    (padded slots print the -1 sentinel, common.cpp:66).
+    """
+    q, kmax = topk.ids.shape
+    in_k = jnp.arange(kmax, dtype=ks.dtype)[None, :] < ks[:, None]
+    d = jnp.where(in_k, topk.dists, jnp.inf)
+    ids = jnp.where(in_k, topk.ids, -1)
+    sd, _, sids = jax.lax.sort((d, -ids, ids), num_keys=2, dimension=-1)
+    return sd, sids, in_k

@@ -1,0 +1,1 @@
+from dmlp_tpu.utils.timing import EngineTimer, format_time_taken  # noqa: F401

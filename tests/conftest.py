@@ -1,0 +1,27 @@
+"""Test environment bootstrap: force a virtual 8-device CPU platform.
+
+Multi-chip tests run on 8 virtual CPU devices (survey §4 implication) — the
+sharded/ring engines are validated exactly as they would run on a TPU slice.
+
+This container routes JAX to a tunneled TPU via an ``axon`` sitecustomize
+hook that registers an extra PJRT backend factory at interpreter start;
+``xla_bridge.backends()`` would then block dialing the TPU tunnel even with
+JAX_PLATFORMS=cpu. Tests must never touch the real chip, so the factory is
+dropped here, before any backend is initialized.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+# The hook may have latched jax_platforms=axon into jax.config before this
+# file ran; both the config and the factory must go.
+jax.config.update("jax_platforms", "cpu")
+_xb._backend_factories.pop("axon", None)

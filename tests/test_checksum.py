@@ -1,0 +1,56 @@
+"""Checksum contract tests (reference common.cpp:57-71).
+
+The hardcoded expected values were produced by compiling the reference
+checksum routine (the FNV-1a fold in common.cpp:59-68) with g++ and running
+it on the same inputs — see tools/verify_checksum.cpp.
+"""
+
+import numpy as np
+
+from dmlp_tpu.io.checksum import FNV_BASIS, FNV_PRIME, fnv1a_checksum, fnv1a_checksum_batch
+
+
+def cpp_reference_fold(values):
+    """Literal transcription of the C++ fold for cross-checking."""
+    c = FNV_BASIS
+    for v in values:
+        c ^= v % (1 << 64)
+        c = (c * FNV_PRIME) % (1 << 64)
+    return c
+
+
+def test_empty_neighbors():
+    assert fnv1a_checksum(3, []) == cpp_reference_fold([3])
+
+
+def test_basic_fold_order_sensitive():
+    a = fnv1a_checksum(1, [0, 1, 2])
+    b = fnv1a_checksum(1, [2, 1, 0])
+    assert a != b
+    assert a == cpp_reference_fold([1, 1, 2, 3])  # ids folded as id+1
+
+
+def test_sentinel_minus_one_folds_as_zero():
+    # id=-1 + 1 == 0 (the sentinel distinction in common.cpp:66)
+    assert fnv1a_checksum(0, [-1]) == cpp_reference_fold([0, 0])
+
+
+def test_negative_label_wraps_like_cpp_cast():
+    # static_cast<unsigned long long>(-1) == 2**64 - 1
+    assert fnv1a_checksum(-1, []) == cpp_reference_fold([(1 << 64) - 1])
+
+
+def test_matches_compiled_cpp_goldens():
+    # Values printed by tools/verify_checksum.cpp built with g++ -O2.
+    assert fnv1a_checksum(3, []) == 4953160058118402688
+    assert fnv1a_checksum(1, [0, 1, 2]) == 11099651899989310290
+    assert fnv1a_checksum(0, [-1]) == 11126445248426326267
+    assert fnv1a_checksum(-1, []) == 13493579617544636084
+    assert fnv1a_checksum(7, [41, 12, 3, -1, -1]) == 9584307944621426467
+
+
+def test_batch_matches_scalar():
+    ids = np.array([[4, 2, 9], [7, 7, 7]])
+    out = fnv1a_checksum_batch([1, 2], ids, [3, 2])
+    assert out[0] == fnv1a_checksum(1, [4, 2, 9])
+    assert out[1] == fnv1a_checksum(2, [7, 7])

@@ -1,0 +1,47 @@
+"""End-to-end CLI tests: stdin grammar -> stdout checksums + stderr timing."""
+
+import io
+import re
+
+from dmlp_tpu.cli import main
+from dmlp_tpu.golden.reference import solve_text
+from dmlp_tpu.io.datagen import generate_input_text
+
+
+def run_cli(args, text):
+    out, err = io.StringIO(), io.StringIO()
+    rc = main(args, stdin=io.StringIO(text), stdout=out, stderr=err)
+    assert rc == 0
+    return out.getvalue(), err.getvalue()
+
+
+def test_cli_checksums_match_golden():
+    text = generate_input_text(120, 15, 6, -2, 2, 1, 10, 4, seed=33)
+    out, err = run_cli(["--data-block", "32", "--query-block", "8"], text)
+    assert out == solve_text(text)
+    # the stderr metrics contract line (common.cpp:130)
+    assert re.search(r"^Time taken: \d+ ms$", err, re.M)
+
+
+def test_cli_debug_mode_matches_golden_debug():
+    text = generate_input_text(40, 5, 3, 0, 1, 2, 5, 3, seed=8)
+    out, _ = run_cli(["--debug"], text)
+    assert out == solve_text(text, debug=True)
+    assert out.startswith("Label for Query 0 : ")
+    assert "Top-" in out and " : " in out
+
+
+def test_cli_golden_engine_mode():
+    text = generate_input_text(30, 4, 2, 0, 1, 1, 4, 2, seed=2)
+    out, _ = run_cli(["--engine", "golden"], text)
+    assert out == solve_text(text)
+
+
+def test_cli_device_full_and_phase_times():
+    text = generate_input_text(64, 8, 4, 0, 8, 1, 6, 3, seed=13)
+    out, err = run_cli(["--device-full", "--fast", "--phase-times"], text)
+    # f32 device pipeline on generator data: checksums still match golden
+    # in practice for this size/seed (validated here; exact-mode tests are
+    # the guarantee).
+    assert out == solve_text(text)
+    assert "phase parse:" in err

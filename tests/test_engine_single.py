@@ -1,0 +1,112 @@
+"""Single-chip engine vs the float64 golden model (differential tests)."""
+
+import numpy as np
+import pytest
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.engine.single import SingleChipEngine
+from dmlp_tpu.golden.reference import knn_golden
+from dmlp_tpu.io.datagen import generate_input_text
+from dmlp_tpu.io.grammar import KNNInput, Params, parse_input_text
+from dmlp_tpu.io.report import format_results
+
+
+def assert_same_results(got, want, check_dists=True):
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g.query_id == w.query_id
+        assert g.k == w.k
+        assert g.predicted_label == w.predicted_label, f"query {g.query_id}"
+        assert list(g.neighbor_ids) == list(w.neighbor_ids), f"query {g.query_id}"
+        assert g.checksum() == w.checksum()
+        if check_dists:
+            np.testing.assert_allclose(g.neighbor_dists, w.neighbor_dists,
+                                       rtol=1e-12)
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_exact_mode_matches_golden(seed):
+    text = generate_input_text(300, 40, 8, -10, 10, 1, 12, 5, seed=seed)
+    inp = parse_input_text(text)
+    eng = SingleChipEngine(EngineConfig(data_block=64, query_block=16))
+    assert_same_results(eng.run(inp), knn_golden(inp))
+
+
+def test_exact_mode_small_blocks_edge():
+    # num_data not a multiple of data_block; num_queries not a multiple of
+    # query_block — exercises padding/masking everywhere.
+    text = generate_input_text(37, 9, 3, 0, 1, 1, 37, 3, seed=5)
+    inp = parse_input_text(text)
+    eng = SingleChipEngine(EngineConfig(data_block=16, query_block=4))
+    assert_same_results(eng.run(inp), knn_golden(inp))
+
+
+def test_duplicate_distance_ties():
+    # Integer grid attrs => many exact distance ties; f32 and f64 agree
+    # exactly, so tie-breaking is what's under test.
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 4, size=(64, 2)).astype(np.float64)
+    queries = rng.integers(0, 4, size=(16, 2)).astype(np.float64)
+    labels = rng.integers(0, 3, size=64).astype(np.int32)
+    ks = rng.integers(1, 20, size=16).astype(np.int32)
+    inp = KNNInput(Params(64, 16, 2), labels, data, ks, queries)
+    eng = SingleChipEngine(EngineConfig(data_block=16, query_block=8))
+    assert_same_results(eng.run(inp), knn_golden(inp))
+
+
+def test_fast_mode_integer_attrs_matches_golden():
+    # exact=False (no f64 rescore): with integer attrs the f32 matmul path
+    # is exact, so even fast mode must reproduce the golden results.
+    rng = np.random.default_rng(3)
+    data = rng.integers(-8, 8, size=(50, 3)).astype(np.float64)
+    queries = rng.integers(-8, 8, size=(10, 3)).astype(np.float64)
+    labels = rng.integers(0, 4, size=50).astype(np.int32)
+    ks = np.full(10, 7, np.int32)
+    inp = KNNInput(Params(50, 10, 3), labels, data, ks, queries)
+    eng = SingleChipEngine(EngineConfig(exact=False, data_block=16, query_block=8))
+    assert_same_results(eng.run(inp), knn_golden(inp), check_dists=False)
+
+
+def test_device_full_pipeline_integer_attrs():
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 6, size=(40, 4)).astype(np.float64)
+    queries = rng.integers(0, 6, size=(12, 4)).astype(np.float64)
+    labels = rng.integers(0, 5, size=40).astype(np.int32)
+    ks = rng.integers(1, 9, size=12).astype(np.int32)
+    inp = KNNInput(Params(40, 12, 4), labels, data, ks, queries)
+    eng = SingleChipEngine(EngineConfig(exact=False, data_block=8, query_block=4))
+    got = eng.run_device_full(inp)
+    want = knn_golden(inp)
+    for g, w in zip(got, want):
+        assert g.predicted_label == w.predicted_label
+        assert list(g.neighbor_ids) == list(w.neighbor_ids)
+        assert g.checksum() == w.checksum()
+
+
+def test_k_equals_num_data():
+    text = generate_input_text(16, 4, 2, 0, 5, 16, 16, 2, seed=9)
+    inp = parse_input_text(text)
+    eng = SingleChipEngine(EngineConfig(data_block=8, query_block=4))
+    assert_same_results(eng.run(inp), knn_golden(inp))
+
+
+def test_k_exceeds_num_data_sentinel_padding():
+    inp = KNNInput(Params(2, 1, 1),
+                   np.array([1, 0], np.int32),
+                   np.array([[0.0], [2.0]]),
+                   np.array([5], np.int32),
+                   np.array([[0.5]]))
+    eng = SingleChipEngine(EngineConfig(data_block=8, query_block=8))
+    got = eng.run(inp)
+    assert list(got[0].neighbor_ids) == [0, 1, -1, -1, -1]
+    assert_same_results(got, knn_golden(inp), check_dists=False)
+
+
+def test_stdout_text_matches_golden():
+    text = generate_input_text(100, 10, 4, -1, 1, 1, 8, 3, seed=21)
+    inp = parse_input_text(text)
+    eng = SingleChipEngine(EngineConfig())
+    got = format_results(eng.run(inp))
+    want = format_results(knn_golden(inp))
+    assert got == want
+    assert got.startswith("Query 0 checksum: ")

@@ -1,0 +1,100 @@
+"""Golden-model tests: the NumPy oracle vs an independent naive solver.
+
+The naive solver below is a deliberately dumb per-query transcription of the
+intended engine.cpp semantics (select comparator engine.cpp:251-254, vote
+:320-332, report sort :334-338) so the vectorized golden model is itself
+differentially tested.
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+from dmlp_tpu.golden.reference import knn_golden, vote
+from dmlp_tpu.io.datagen import generate_input_text
+from dmlp_tpu.io.grammar import KNNInput, Params, parse_input_text
+
+
+def naive_solve(inp: KNNInput):
+    out = []
+    for qi in range(inp.params.num_queries):
+        k = int(inp.ks[qi])
+        cands = []
+        for di in range(inp.params.num_data):
+            d = float(((inp.query_attrs[qi] - inp.data_attrs[di]) ** 2).sum())
+            cands.append((d, int(inp.labels[di]), di))
+        # selection order: dist asc, label desc, id desc
+        cands.sort(key=lambda t: (t[0], -t[1], -t[2]))
+        sel = cands[:k]
+        counts = collections.Counter(lab for _, lab, _ in sel)
+        pred = max(counts.items(), key=lambda kv: (kv[1], kv[0]))[0] if sel else -1
+        # report order: dist asc, id desc
+        rep = sorted(sel, key=lambda t: (t[0], -t[2]))
+        ids = [i for _, _, i in rep] + [-1] * (k - len(rep))
+        out.append((pred, ids))
+    return out
+
+
+def make_input(labels, data, ks, queries):
+    data = np.asarray(data, np.float64)
+    queries = np.asarray(queries, np.float64)
+    return KNNInput(Params(len(labels), len(ks), data.shape[1]),
+                    np.asarray(labels, np.int32), data,
+                    np.asarray(ks, np.int32), queries)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_golden_matches_naive_random(seed):
+    text = generate_input_text(60, 20, 5, -3, 3, 1, 10, 4, seed=seed)
+    inp = parse_input_text(text)
+    golden = knn_golden(inp, query_block=7)  # odd block to exercise blocking
+    naive = naive_solve(inp)
+    for r, (pred, ids) in zip(golden, naive):
+        assert r.predicted_label == pred
+        assert list(r.neighbor_ids) == ids
+
+
+def test_tie_breaking_duplicate_points():
+    # Four identical points: distance ties everywhere. Selection must prefer
+    # larger label, then larger id; report must order by larger id.
+    inp = make_input(labels=[1, 3, 3, 0],
+                     data=[[0.0], [0.0], [0.0], [0.0]],
+                     ks=[2], queries=[[0.0]])
+    (r,) = knn_golden(inp)
+    # label-3 points (ids 1,2) win selection; id desc among them in report.
+    assert list(r.neighbor_ids) == [2, 1]
+    assert r.predicted_label == 3
+    naive = naive_solve(inp)
+    assert (r.predicted_label, list(r.neighbor_ids)) == naive[0]
+
+
+def test_vote_tie_prefers_larger_label():
+    inp = make_input(labels=[5, 2, 5, 2],
+                     data=[[0.0], [1.0], [2.0], [3.0]],
+                     ks=[4], queries=[[0.0]])
+    (r,) = knn_golden(inp)
+    assert r.predicted_label == 5
+    assert list(r.neighbor_ids) == [0, 1, 2, 3]
+
+
+def test_equidistant_pair_report_order():
+    # Query at 0, points at ±1: equal distance; larger id first in report.
+    inp = make_input(labels=[0, 0], data=[[1.0], [-1.0]],
+                     ks=[2], queries=[[0.0]])
+    (r,) = knn_golden(inp)
+    assert list(r.neighbor_ids) == [1, 0]
+
+
+def test_k_exceeds_num_data_pads_with_sentinel():
+    inp = make_input(labels=[2], data=[[0.0]], ks=[3], queries=[[1.0]])
+    (r,) = knn_golden(inp)
+    assert list(r.neighbor_ids) == [0, -1, -1]
+    assert r.predicted_label == 2
+    assert np.isinf(r.neighbor_dists[1])
+    # checksum folds sentinels as 0 (+1) — must not raise
+    assert isinstance(r.checksum(), int)
+
+
+def test_vote_empty():
+    assert vote(np.array([], np.int64)) == -1

@@ -1,0 +1,55 @@
+"""Input-grammar tests (reference common.cpp:12-55)."""
+
+import numpy as np
+import pytest
+
+from dmlp_tpu.io.grammar import format_input, parse_input_text, parse_update
+
+
+SAMPLE = """3 2 2
+0 1.000000 2.000000
+1 3.500000 -4.250000
+0 0.000000 0.000000
+Q 2 1.000000 1.000000
+Q 1 -1.000000 2.500000
+"""
+
+
+def test_parse_basic():
+    inp = parse_input_text(SAMPLE)
+    assert (inp.params.num_data, inp.params.num_queries, inp.params.num_attrs) == (3, 2, 2)
+    np.testing.assert_array_equal(inp.labels, [0, 1, 0])
+    np.testing.assert_allclose(inp.data_attrs[1], [3.5, -4.25])
+    np.testing.assert_array_equal(inp.ks, [2, 1])
+    np.testing.assert_allclose(inp.query_attrs[1], [-1.0, 2.5])
+    np.testing.assert_array_equal(inp.data_ids, [0, 1, 2])
+    np.testing.assert_array_equal(inp.query_ids, [0, 1])
+
+
+def test_roundtrip():
+    inp = parse_input_text(SAMPLE)
+    assert format_input(inp) == SAMPLE
+
+
+def test_empty_data_line_raises():
+    bad = "2 0 1\n1 0.5\n\n"
+    with pytest.raises(ValueError, match="Line is empty"):
+        parse_input_text(bad)
+
+
+def test_malformed_query_line_raises():
+    # Same error text as common.cpp:114.
+    bad = "1 1 1\n0 0.5\nX 1 0.5\n"
+    with pytest.raises(ValueError, match="Line is wrongly formatted"):
+        parse_input_text(bad)
+
+
+def test_truncated_input_raises():
+    with pytest.raises(ValueError, match="record lines"):
+        parse_input_text("5 5 2\n0 1.0 2.0\n")
+
+
+def test_parse_update():
+    u = parse_update("7 1.5 2.5 3.5")
+    assert u.id == 7
+    np.testing.assert_allclose(u.new_attrs, [1.5, 2.5, 3.5])

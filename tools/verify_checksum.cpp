@@ -1,0 +1,26 @@
+// Standalone cross-check of the result checksum semantics
+// (the FNV-1a fold of reference common.cpp:57-71), used to validate
+// dmlp_tpu.io.checksum. Build: g++ -O2 -o verify_checksum verify_checksum.cpp
+// Prints checksums for a handful of (label, ids...) cases.
+#include <cstdio>
+#include <vector>
+
+unsigned long long checksum_of(int label, const std::vector<int>& ids) {
+    unsigned long long checksum = 1469598103934665603ULL;
+    checksum ^= static_cast<unsigned long long>(label);
+    checksum *= 1099511628211ULL;
+    for (int idx : ids) {
+        checksum ^= static_cast<unsigned long long>(idx + 1);
+        checksum *= 1099511628211ULL;
+    }
+    return checksum;
+}
+
+int main() {
+    printf("%llu\n", checksum_of(3, {}));
+    printf("%llu\n", checksum_of(1, {0, 1, 2}));
+    printf("%llu\n", checksum_of(0, {-1}));
+    printf("%llu\n", checksum_of(-1, {}));
+    printf("%llu\n", checksum_of(7, {41, 12, 3, -1, -1}));
+    return 0;
+}
