@@ -66,6 +66,9 @@ def main(argv: Optional[Sequence[str]] = None,
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
 
+    if args.device_full and args.mode != "single":
+        parser.error("--device-full currently supports --mode single only")
+
     config = EngineConfig(mode=args.mode, debug=args.debug,
                           exact=not args.fast, data_block=args.data_block,
                           query_block=args.query_block, dtype=args.dtype)

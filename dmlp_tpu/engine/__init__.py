@@ -1,2 +1,3 @@
 from dmlp_tpu.engine.single import SingleChipEngine  # noqa: F401
+from dmlp_tpu.engine.sharded import RingEngine, ShardedEngine  # noqa: F401
 from dmlp_tpu.engine.finalize import finalize_host  # noqa: F401

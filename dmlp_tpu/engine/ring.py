@@ -1,0 +1,5 @@
+"""Ring-streaming engine (CLI registry home; implementation in sharded.py,
+which both mesh engines share — they differ only in the cross-shard merge:
+all-gather vs merge-top-k ring all-reduce)."""
+
+from dmlp_tpu.engine.sharded import RingEngine  # noqa: F401

@@ -1,0 +1,2 @@
+from dmlp_tpu.parallel.mesh import make_mesh, balanced_dims, DATA_AXIS, QUERY_AXIS  # noqa: F401
+from dmlp_tpu.parallel.collectives import ring_allreduce_topk, allgather_merge_topk  # noqa: F401

@@ -3,6 +3,8 @@
 import io
 import re
 
+import pytest
+
 from dmlp_tpu.cli import main
 from dmlp_tpu.golden.reference import solve_text
 from dmlp_tpu.io.datagen import generate_input_text
@@ -21,6 +23,15 @@ def test_cli_checksums_match_golden():
     assert out == solve_text(text)
     # the stderr metrics contract line (common.cpp:130)
     assert re.search(r"^Time taken: \d+ ms$", err, re.M)
+
+
+@pytest.mark.parametrize("mode", ["single", "sharded", "ring"])
+def test_cli_every_mode_matches_golden(mode):
+    # Guards the CLI registry: every --mode must resolve and give
+    # golden-identical output.
+    text = generate_input_text(90, 11, 4, -3, 3, 1, 7, 3, seed=44)
+    out, _ = run_cli(["--mode", mode], text)
+    assert out == solve_text(text)
 
 
 def test_cli_debug_mode_matches_golden_debug():

@@ -1,0 +1,82 @@
+"""Sharded/ring engines on the virtual 8-device CPU mesh vs the golden model."""
+
+import jax
+import numpy as np
+import pytest
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.engine.sharded import RingEngine, ShardedEngine
+from dmlp_tpu.golden.reference import knn_golden
+from dmlp_tpu.io.datagen import generate_input_text
+from dmlp_tpu.io.grammar import KNNInput, Params, parse_input_text
+from dmlp_tpu.parallel.mesh import balanced_dims, make_mesh
+
+from test_engine_single import assert_same_results
+
+
+def needs_devices(n):
+    return pytest.mark.skipif(len(jax.devices()) < n,
+                              reason=f"needs {n} devices")
+
+
+def test_balanced_dims():
+    assert balanced_dims(8) == (4, 2)
+    assert balanced_dims(24) == (6, 4)
+    assert balanced_dims(1) == (1, 1)
+    assert balanced_dims(7) == (7, 1)
+
+
+@needs_devices(8)
+@pytest.mark.parametrize("shape", [(4, 2), (2, 4), (8, 1), (1, 8)])
+def test_sharded_matches_golden(shape):
+    text = generate_input_text(230, 33, 6, -5, 5, 1, 11, 4, seed=17)
+    inp = parse_input_text(text)
+    eng = ShardedEngine(EngineConfig(mode="sharded", data_block=16),
+                        mesh=make_mesh(shape))
+    assert_same_results(eng.run(inp), knn_golden(inp))
+
+
+@needs_devices(8)
+def test_ring_matches_golden_and_allgather():
+    text = generate_input_text(150, 21, 5, -2, 2, 1, 9, 3, seed=23)
+    inp = parse_input_text(text)
+    ring = RingEngine(EngineConfig(mode="ring", data_block=8),
+                      mesh=make_mesh((4, 2)))
+    got = ring.run(inp)
+    assert_same_results(got, knn_golden(inp))
+    ag = ShardedEngine(EngineConfig(mode="sharded", data_block=8),
+                       mesh=make_mesh((4, 2)))
+    assert_same_results(got, ag.run(inp))
+
+
+@needs_devices(8)
+def test_sharded_tiny_uneven_input():
+    # num_data < number of data shards exercises all-sentinel shards.
+    text = generate_input_text(3, 5, 2, 0, 1, 1, 3, 2, seed=4)
+    inp = parse_input_text(text)
+    for cls, mode in ((ShardedEngine, "sharded"), (RingEngine, "ring")):
+        eng = cls(EngineConfig(mode=mode), mesh=make_mesh((4, 2)))
+        assert_same_results(eng.run(inp), knn_golden(inp))
+
+
+@needs_devices(8)
+def test_sharded_ties_integer_attrs_fast_mode():
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 4, size=(64, 3)).astype(np.float64)
+    queries = rng.integers(0, 4, size=(16, 3)).astype(np.float64)
+    labels = rng.integers(0, 3, size=64).astype(np.int32)
+    ks = rng.integers(1, 20, size=16).astype(np.int32)
+    inp = KNNInput(Params(64, 16, 3), labels, data, ks, queries)
+    for cls in (ShardedEngine, RingEngine):
+        eng = cls(EngineConfig(mode="sharded" if cls is ShardedEngine else "ring",
+                               exact=False, data_block=8),
+                  mesh=make_mesh((4, 2)))
+        assert_same_results(eng.run(inp), knn_golden(inp), check_dists=False)
+
+
+def test_sharded_single_device_mesh():
+    text = generate_input_text(40, 6, 3, 0, 1, 1, 5, 2, seed=6)
+    inp = parse_input_text(text)
+    eng = ShardedEngine(EngineConfig(mode="sharded"),
+                        mesh=make_mesh((1, 1), devices=jax.devices()[:1]))
+    assert_same_results(eng.run(inp), knn_golden(inp))
