@@ -1,0 +1,116 @@
+"""Driver benchmark: times the TPU-native KNN solve, prints ONE JSON line.
+
+Workload: the reference's headline benchmark shape — brute-force KNN
+classification (survey §6). The timed region matches the reference's
+(common.cpp:122-131 brackets only Engine::KNN, after ingest): device solve
+only, compile excluded (XLA compiles once per shape; the reference pays no
+JIT either).
+
+Baseline: a blocked NumPy (BLAS f32) implementation of the same solve on the
+host CPU — the portable stand-in for the reference's CPU/MPI engine, whose
+published numbers do not exist and whose binaries cannot run here (survey §6).
+``vs_baseline`` is the speedup ratio baseline_ms / engine_ms (>1 = faster).
+
+Env overrides: BENCH_NUM_DATA, BENCH_NUM_QUERIES, BENCH_NUM_ATTRS, BENCH_K,
+BENCH_REPEATS, BENCH_MODE (single|sharded|ring).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def make_workload(num_data: int, num_queries: int, num_attrs: int, k: int,
+                  seed: int = 42):
+    """Synthetic workload with the generator's distribution
+    (generate_input.py:13-21: uniform attrs, uniform labels, fixed seed) —
+    built as arrays directly; text parsing is outside the timed region anyway.
+    """
+    rng = np.random.default_rng(seed)
+    data_attrs = rng.uniform(0.0, 100.0, (num_data, num_attrs))
+    query_attrs = rng.uniform(0.0, 100.0, (num_queries, num_attrs))
+    labels = rng.integers(0, 10, num_data, dtype=np.int32)
+    ks = np.full(num_queries, k, dtype=np.int32)
+    from dmlp_tpu.io.grammar import KNNInput, Params
+    return KNNInput(Params(num_data, num_queries, num_attrs), labels,
+                    data_attrs, ks, query_attrs)
+
+
+def time_baseline_ms(inp, k: int, sample_queries: int = 1024,
+                     block: int = 256) -> float:
+    """Blocked NumPy KNN solve time, measured on a query subsample and
+    scaled linearly to the full query count (matmul cost is linear in Q)."""
+    d = inp.data_attrs.astype(np.float32)
+    dn = (d * d).sum(axis=1)
+    qs = min(sample_queries, inp.params.num_queries)
+    q = inp.query_attrs[:qs].astype(np.float32)
+
+    t0 = time.perf_counter()
+    for q0 in range(0, qs, block):
+        qb = q[q0:q0 + block]
+        dist = (qb * qb).sum(axis=1)[:, None] + dn[None, :] - 2.0 * (qb @ d.T)
+        idx = np.argpartition(dist, kth=min(k, dist.shape[1] - 1), axis=1)[:, :k]
+        lab = inp.labels[idx]
+        # majority vote per row (same O() work as the engine's vote)
+        for r in range(lab.shape[0]):
+            np.bincount(lab[r], minlength=10).argmax()
+    elapsed = (time.perf_counter() - t0) * 1e3
+    return elapsed * (inp.params.num_queries / qs)
+
+
+def time_engine_ms(inp, mode: str, repeats: int) -> float:
+    import jax
+    from dmlp_tpu.cli import make_engine
+    from dmlp_tpu.config import EngineConfig
+
+    cfg = EngineConfig(mode=mode, exact=False, dtype="float32",
+                       query_block=2048)
+    engine = make_engine(cfg)
+
+    run = (engine.run_device_full if mode == "single" else engine.run)
+    run(inp)  # warmup: compile + first dispatch
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run(inp)
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(times))
+
+
+def main() -> int:
+    num_data = _env_int("BENCH_NUM_DATA", 200_000)
+    num_queries = _env_int("BENCH_NUM_QUERIES", 10_000)
+    num_attrs = _env_int("BENCH_NUM_ATTRS", 64)
+    k = _env_int("BENCH_K", 32)
+    repeats = _env_int("BENCH_REPEATS", 3)
+    mode = os.environ.get("BENCH_MODE", "single")
+
+    inp = make_workload(num_data, num_queries, num_attrs, k)
+    engine_ms = time_engine_ms(inp, mode, repeats)
+    baseline_ms = time_baseline_ms(inp, k)
+
+    pairs_per_s = num_data * num_queries / (engine_ms / 1e3)
+    print(json.dumps({
+        "metric": "knn_solve_ms",
+        "value": round(engine_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(baseline_ms / engine_ms, 3),
+        "baseline_ms": round(baseline_ms, 1),
+        "qd_pairs_per_sec": round(pairs_per_s),
+        "shape": {"num_data": num_data, "num_queries": num_queries,
+                  "num_attrs": num_attrs, "k": k, "mode": mode},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

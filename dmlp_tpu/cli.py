@@ -54,10 +54,14 @@ def main(argv: Optional[Sequence[str]] = None,
                         help="skip the float64 host rescore (f32 ordering)")
     parser.add_argument("--device-full", action="store_true",
                         help="vote + report ordering on device too")
-    parser.add_argument("--data-block", type=int, default=2048)
+    parser.add_argument("--data-block", type=int, default=None,
+                        help="data rows per inner step (default: per-select)")
     parser.add_argument("--query-block", type=int, default=1024)
     parser.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16"])
+    parser.add_argument("--select", default="auto",
+                        choices=["auto", "sort", "topk"],
+                        help="device k-selection strategy")
     parser.add_argument("--phase-times", action="store_true",
                         help="per-phase ms breakdown on stderr (extension)")
     args = parser.parse_args(argv)
@@ -71,7 +75,8 @@ def main(argv: Optional[Sequence[str]] = None,
 
     config = EngineConfig(mode=args.mode, debug=args.debug,
                           exact=not args.fast, data_block=args.data_block,
-                          query_block=args.query_block, dtype=args.dtype)
+                          query_block=args.query_block, dtype=args.dtype,
+                          select=args.select)
 
     timer = EngineTimer()
     with timer.phase("parse"):

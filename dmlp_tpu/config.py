@@ -28,6 +28,9 @@ class EngineConfig:
         available devices (mirrors MPI_Dims_create at engine.cpp:41).
       data_block: data points processed per inner step on one chip.
         Bounds the live distance-tile to query_block x data_block.
+        None = pick per select strategy (2048 for "sort", whose per-step
+        cost grows superlinearly in the block; 65536 for "topk", which
+        prefers large tiles).
       query_block: queries processed per outer step.
       dtype: on-device distance dtype ("float32" or "bfloat16").
         The reference computes in float64 (engine.cpp:12); TPU MXU is
@@ -37,18 +40,30 @@ class EngineConfig:
         checksum parity with the golden model) while keeping the O(Q*N*A)
         work on the MXU.
       margin: extra candidates (beyond max-k) carried to the host rescore.
+      select: device k-selection strategy. "sort" = strict total-order
+        multi-operand sort (reference tie semantics on device, slow);
+        "topk" = ``lax.top_k`` partial reduce, ~4x faster but
+        tie-order-blind — engines detect candidate lists where a
+        distance-tie group hit the boundary and recompute those queries
+        exactly on host (engine.finalize.boundary_overflow), so ``run()``
+        parity holds on either path; "auto" = "sort" for small inputs
+        (tie repair there could dominate), "topk" once the padded
+        dataset exceeds AUTO_SELECT_THRESHOLD rows.
       debug: human-readable output instead of checksums — the -DDEBUG
         build of the reference (common.cpp:72-78).
       use_pallas: use the fused Pallas distance kernel where available.
     """
 
+    AUTO_SELECT_THRESHOLD = 8192
+
     mode: str = "single"
     mesh_shape: Optional[Tuple[int, int]] = None
-    data_block: int = 2048
+    data_block: Optional[int] = None
     query_block: int = 1024
     dtype: str = "float32"
     exact: bool = True
     margin: int = 16
+    select: str = "auto"
     debug: bool = False
     use_pallas: bool = False
 
@@ -57,7 +72,21 @@ class EngineConfig:
             raise ValueError(f"unknown mode {self.mode!r}")
         if self.dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unsupported dtype {self.dtype!r}")
-        if self.data_block <= 0 or self.query_block <= 0:
+        if self.select not in ("auto", "sort", "topk"):
+            raise ValueError(f"unknown select {self.select!r}")
+        if (self.data_block is not None and self.data_block <= 0) \
+                or self.query_block <= 0:
             raise ValueError("block sizes must be positive")
         if self.margin < 0:
             raise ValueError("margin must be >= 0")
+
+    def resolve_select(self, padded_rows: int) -> str:
+        """Concrete selection strategy for a dataset of ``padded_rows``."""
+        if self.select != "auto":
+            return self.select
+        return "topk" if padded_rows > self.AUTO_SELECT_THRESHOLD else "sort"
+
+    def resolve_data_block(self, select: str) -> int:
+        if self.data_block is not None:
+            return self.data_block
+        return 65536 if select == "topk" else 2048

@@ -68,6 +68,59 @@ def rescore_f64(cand_ids: np.ndarray, query_attrs: np.ndarray,
     return out
 
 
+def boundary_overflow(device_dists: np.ndarray, ks: np.ndarray) -> np.ndarray:
+    """Queries whose fast-path candidate set may have truncated a tie group.
+
+    The "topk" selection keeps the K smallest device distances with ties
+    broken by position, not by the reference's (label desc, id desc)
+    preference (dmlp_tpu.ops.topk). A query's true top-k can then be missing
+    from the candidates only if >= K entries tie at or below its k-th
+    distance — which implies its k-th candidate distance equals the K-th
+    (last) one. That equality is the hazard test: exact (conservative — it
+    can flag safe queries, never miss an unsafe one) and computable from the
+    raw device distances alone. Flagged queries are recomputed exactly on
+    host (engines call dmlp_tpu.golden on just those), so parity survives
+    adversarial duplicate-heavy data on the fast path too.
+
+    Args:
+      device_dists: (Q, K) raw device candidate distances, selection order.
+      ks: (Q,) per-query k.
+
+    Returns:
+      (Q,) bool mask of suspect queries.
+    """
+    q, kcap = device_dists.shape
+    if q == 0 or kcap == 0:
+        return np.zeros(q, bool)
+    last = device_dists[:, kcap - 1]
+    kth = device_dists[np.arange(q), np.clip(np.asarray(ks) - 1, 0, kcap - 1)]
+    # +inf in the last slot means the candidate list wasn't even full of
+    # real points — nothing can have been truncated.
+    return np.isfinite(last) & (last == kth)
+
+
+def repair_boundary_overflow(results: List[QueryResult],
+                             suspect_idx: np.ndarray, inp) -> None:
+    """Recompute the flagged queries exactly (golden model) in place.
+
+    ``suspect_idx`` holds local query indices (positions in ``results`` /
+    ``inp`` row order); the repaired entries keep their original query ids.
+    """
+    from dmlp_tpu.golden.reference import knn_golden
+    from dmlp_tpu.io.grammar import KNNInput, Params
+
+    sub = KNNInput(
+        Params(inp.params.num_data, len(suspect_idx), inp.params.num_attrs),
+        inp.labels, inp.data_attrs,
+        inp.ks[suspect_idx], inp.query_attrs[suspect_idx])
+    fixed_all = knn_golden(sub)
+    for j, qi in enumerate(np.asarray(suspect_idx)):
+        fixed = fixed_all[j]
+        results[qi] = QueryResult(results[qi].query_id, fixed.k,
+                                  fixed.predicted_label, fixed.neighbor_ids,
+                                  fixed.neighbor_dists)
+
+
 def finalize_host(cand_dists: np.ndarray, cand_labels: np.ndarray,
                   cand_ids: np.ndarray, ks: np.ndarray,
                   query_attrs: np.ndarray, data_attrs: np.ndarray,

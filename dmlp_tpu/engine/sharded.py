@@ -29,8 +29,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlp_tpu.config import EngineConfig
-from dmlp_tpu.engine.finalize import finalize_host
-from dmlp_tpu.engine.single import pad_dataset, round_up
+from dmlp_tpu.engine.finalize import (boundary_overflow, finalize_host,
+                                      repair_boundary_overflow)
+from dmlp_tpu.engine.single import fit_blocks, pad_dataset, round_up
 from dmlp_tpu.io.grammar import KNNInput
 from dmlp_tpu.io.report import QueryResult
 from dmlp_tpu.ops.topk import streaming_topk
@@ -48,7 +49,7 @@ class ShardedEngine:
         self.config = config
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh_shape)
         self._dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
-        self._fns: Dict[Tuple[int, int], object] = {}
+        self._fns: Dict[Tuple[int, int, str], object] = {}  # (k, block, select)
 
     # -- sharded placement ---------------------------------------------------
     def _shard_inputs(self, inp: KNNInput, data_block: int):
@@ -57,9 +58,9 @@ class ShardedEngine:
         na = inp.params.num_attrs
         # r * round_up(ceil(n/r), b) == round_up(n, r*b), so the per-shard
         # row count divides data_block as streaming_topk requires.
-        attrs, labels, ids = pad_dataset(inp, r * data_block, np.float64)
+        attrs, labels, ids = pad_dataset(inp, r * data_block, np.float32)
         qpad = c * round_up(max(-(-q // c), 1), 8)
-        q_attrs = np.zeros((qpad, na), np.float64); q_attrs[:q] = inp.query_attrs
+        q_attrs = np.zeros((qpad, na), np.float32); q_attrs[:q] = inp.query_attrs
 
         dsh = NamedSharding(self.mesh, P(DATA_AXIS, None))
         dsh1 = NamedSharding(self.mesh, P(DATA_AXIS))
@@ -70,14 +71,15 @@ class ShardedEngine:
                 jax.device_put(jnp.asarray(q_attrs, self._dtype), qsh))
 
     # -- the compiled sharded program ---------------------------------------
-    def _fn(self, k: int, data_block: int):
-        key = (k, data_block)
+    def _fn(self, k: int, data_block: int, select: str):
+        key = (k, data_block, select)
         if key not in self._fns:
             merge = self._merge_strategy
 
             def local(data_a, data_l, data_i, q_attrs):
                 top = streaming_topk(q_attrs, data_a, data_l, data_i,
-                                     k=k, data_block=data_block)
+                                     k=k, data_block=data_block,
+                                     select=select)
                 if merge == "allgather":
                     return allgather_merge_topk(top, k, DATA_AXIS)
                 return ring_allreduce_topk(top, k, DATA_AXIS)
@@ -96,14 +98,22 @@ class ShardedEngine:
         cfg = self.config
         n = inp.params.num_data
         r = self.mesh.devices.shape[0]
-        data_block = min(cfg.data_block, round_up(max(-(-n // r), 1), 8))
+        shard_rows_est = round_up(max(-(-n // r), 1), 8)
+        select = cfg.resolve_select(shard_rows_est)
+        if cfg.data_block is not None:
+            data_block = min(cfg.data_block, shard_rows_est)
+        else:
+            data_block, _ = fit_blocks(max(-(-n // r), 1),
+                                       cfg.resolve_data_block(select))
         d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(inp, data_block)
         kmax = int(inp.ks.max()) if inp.params.num_queries else 1
         extra = cfg.margin if cfg.exact else 0
         shard_rows = d_attrs.shape[0] // r
         k = max(min(round_up(kmax + extra, 8), shard_rows * r), kmax)
 
-        top = self._fn(k, data_block)(d_attrs, d_labels, d_ids, q_attrs)
+        self._last_select = select  # run() gates the tie-overflow repair
+        top = self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
+                                              q_attrs)
         nq = inp.params.num_queries
         return (np.asarray(top.dists, np.float64)[:nq],
                 np.asarray(top.labels)[:nq],
@@ -111,8 +121,16 @@ class ShardedEngine:
 
     def run(self, inp: KNNInput) -> List[QueryResult]:
         dists, labels, ids = self.candidates(inp)
-        return finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
-                             inp.data_attrs, exact=self.config.exact)
+        results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
+                                inp.data_attrs, exact=self.config.exact)
+        if self._last_select == "topk":
+            # Per-shard truncation of a tie group surfaces as the same
+            # boundary equality on the merged lists (the tie value fills the
+            # tail), so one detector covers both engines.
+            suspects = np.nonzero(boundary_overflow(dists, inp.ks))[0]
+            if suspects.size:
+                repair_boundary_overflow(results, suspects, inp)
+        return results
 
     def run_device_full(self, inp: KNNInput) -> List[QueryResult]:
         # Device-side vote/report for the sharded path lands with the bench

@@ -27,7 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from dmlp_tpu.config import EngineConfig
-from dmlp_tpu.engine.finalize import finalize_host
+from dmlp_tpu.engine.finalize import (boundary_overflow, finalize_host,
+                                      repair_boundary_overflow)
 from dmlp_tpu.io.grammar import KNNInput
 from dmlp_tpu.io.report import QueryResult
 from dmlp_tpu.ops.topk import TopK, streaming_topk
@@ -38,6 +39,21 @@ def round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def fit_blocks(n: int, target_block: int) -> Tuple[int, int]:
+    """(data_block, npad) with npad % data_block == 0, data_block % 8 == 0,
+    data_block <= ~target_block, and padding waste < 8 * nblocks rows.
+
+    Plain round_up(n, target_block) can waste up to target_block - 1 rows
+    (31% at n=200k, target=64k) — real compute, since padded rows still ride
+    the matmul. Shrinking the block to ~n/nblocks keeps the scan length and
+    the waste both minimal.
+    """
+    n = max(n, 1)
+    nblocks = max(1, -(-n // max(target_block, 8)))
+    block = round_up(-(-n // nblocks), 8)
+    return block, block * nblocks
+
+
 def pad_dataset(inp: KNNInput, multiple: int, dtype: np.dtype
                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Pad (attrs, labels, ids) to a multiple of ``multiple`` rows.
@@ -46,6 +62,11 @@ def pad_dataset(inp: KNNInput, multiple: int, dtype: np.dtype
     them to +inf (masked_pairwise_sq_l2). This replaces the reference's
     uneven remainder shards (engine.cpp:62-63) — XLA wants static, uniform
     shapes.
+
+    ``dtype`` should be the host-side staging dtype: padding straight into
+    float32 halves the memcpy and the host->device bytes relative to staging
+    in the parser's float64 (the f64 originals stay available for the exact
+    host rescore).
     """
     n = inp.params.num_data
     npad = round_up(max(n, 1), multiple)
@@ -58,21 +79,32 @@ def pad_dataset(inp: KNNInput, multiple: int, dtype: np.dtype
     return attrs, labels, ids
 
 
-@functools.partial(jax.jit, static_argnames=("k", "data_block"))
-def _topk_block(data_attrs, data_labels, data_ids, q_attrs, *, k, data_block):
-    return streaming_topk(q_attrs, data_attrs, data_labels, data_ids,
-                          k=k, data_block=data_block)
+@functools.partial(jax.jit, static_argnames=("k", "data_block", "select"))
+def _topk_blocks(data_attrs, data_labels, data_ids, q_blocks, *, k,
+                 data_block, select):
+    """All query blocks in one dispatch: ``lax.map`` keeps the live distance
+    tile at (query_block x data_block) while avoiding per-block Python
+    dispatch + per-block device->host readbacks (which dominate over a
+    tunneled PJRT link)."""
+    return jax.lax.map(
+        lambda q: streaming_topk(q, data_attrs, data_labels, data_ids,
+                                 k=k, data_block=data_block, select=select),
+        q_blocks)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "data_block", "num_labels"))
-def _full_block(data_attrs, data_labels, data_ids, q_attrs, ks, *,
-                k, data_block, num_labels):
-    top = streaming_topk(q_attrs, data_attrs, data_labels, data_ids,
-                         k=k, data_block=data_block)
-    rd, rids, in_k = report_order(top, ks)
-    valid = in_k & (top.ids >= 0)
-    predicted = majority_vote(top.labels, valid, num_labels)
-    return predicted, rids, rd
+@functools.partial(jax.jit,
+                   static_argnames=("k", "data_block", "num_labels", "select"))
+def _full_blocks(data_attrs, data_labels, data_ids, q_blocks, ks_blocks, *,
+                 k, data_block, num_labels, select):
+    def one(args):
+        q_attrs, ks = args
+        top = streaming_topk(q_attrs, data_attrs, data_labels, data_ids,
+                             k=k, data_block=data_block, select=select)
+        rd, rids, in_k = report_order(top, ks)
+        valid = in_k & (top.ids >= 0)
+        predicted = majority_vote(top.labels, valid, num_labels)
+        return predicted, rids, rd
+    return jax.lax.map(one, (q_blocks, ks_blocks))
 
 
 class SingleChipEngine:
@@ -85,65 +117,79 @@ class SingleChipEngine:
     def _prep(self, inp: KNNInput):
         cfg = self.config
         n = inp.params.num_data
-        data_block = min(cfg.data_block, round_up(max(n, 1), 8))
-        attrs, labels, ids = pad_dataset(inp, data_block, np.float64)
+        select = cfg.resolve_select(round_up(max(n, 1), 8))
+        if cfg.data_block is not None:
+            data_block = min(cfg.data_block, round_up(max(n, 1), 8))
+        else:
+            data_block, _ = fit_blocks(n, cfg.resolve_data_block(select))
+        attrs, labels, ids = pad_dataset(inp, data_block, np.float32)
         kmax = int(inp.ks.max()) if inp.params.num_queries else 1
         extra = cfg.margin if cfg.exact else 0
         k = min(round_up(kmax + extra, 8), attrs.shape[0])
         k = max(k, kmax)  # never below the widest query's k
         d_attrs = jnp.asarray(attrs, self._dtype)
-        return d_attrs, jnp.asarray(labels), jnp.asarray(ids), k, data_block
+        self._last_select = select  # run() gates the tie-overflow repair on it
+        return (d_attrs, jnp.asarray(labels), jnp.asarray(ids), k, data_block,
+                select)
 
     def candidates(self, inp: KNNInput) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Device pass: (Q, K) selection-ordered candidate lists as NumPy."""
         cfg = self.config
-        d_attrs, d_labels, d_ids, k, data_block = self._prep(inp)
+        d_attrs, d_labels, d_ids, k, data_block, select = self._prep(inp)
         nq = inp.params.num_queries
         qb = min(cfg.query_block, round_up(max(nq, 1), 8))
         qpad = round_up(max(nq, 1), qb)
-        q_attrs = np.zeros((qpad, inp.params.num_attrs), np.float64)
+        q_attrs = np.zeros((qpad, inp.params.num_attrs), np.float32)
         q_attrs[:nq] = inp.query_attrs
+        q_blocks = jnp.asarray(
+            q_attrs.reshape(qpad // qb, qb, -1), self._dtype)
 
-        outs: List[TopK] = []
-        for q0 in range(0, qpad, qb):
-            blk = jnp.asarray(q_attrs[q0:q0 + qb], self._dtype)
-            outs.append(_topk_block(d_attrs, d_labels, d_ids, blk,
-                                    k=k, data_block=data_block))
-        dists = np.concatenate([np.asarray(o.dists, np.float64) for o in outs])[:nq]
-        labels = np.concatenate([np.asarray(o.labels) for o in outs])[:nq]
-        ids = np.concatenate([np.asarray(o.ids) for o in outs])[:nq]
+        out: TopK = _topk_blocks(d_attrs, d_labels, d_ids, q_blocks,
+                                 k=k, data_block=data_block, select=select)
+        dists = np.asarray(out.dists, np.float64).reshape(qpad, -1)[:nq]
+        labels = np.asarray(out.labels).reshape(qpad, -1)[:nq]
+        ids = np.asarray(out.ids).reshape(qpad, -1)[:nq]
         return dists, labels, ids
 
     def run(self, inp: KNNInput) -> List[QueryResult]:
-        """Full parity pipeline: device candidates + host float64 finalize."""
+        """Full parity pipeline: device candidates + host float64 finalize.
+
+        On the fast "topk" selection path, queries whose candidate set may
+        have truncated a distance-tie group (boundary_overflow) are
+        recomputed exactly — parity holds on either path.
+        """
         dists, labels, ids = self.candidates(inp)
-        return finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
-                             inp.data_attrs, exact=self.config.exact)
+        results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
+                                inp.data_attrs, exact=self.config.exact)
+        if self._last_select == "topk":
+            suspects = np.nonzero(boundary_overflow(dists, inp.ks))[0]
+            if suspects.size:
+                repair_boundary_overflow(results, suspects, inp)
+        return results
 
     def run_device_full(self, inp: KNNInput) -> List[QueryResult]:
         """All-device pipeline (vote + report order on TPU); f32 ordering."""
         cfg = self.config
-        d_attrs, d_labels, d_ids, k, data_block = self._prep(inp)
+        d_attrs, d_labels, d_ids, k, data_block, select = self._prep(inp)
         nq = inp.params.num_queries
         num_labels = int(inp.labels.max()) + 1 if inp.params.num_data else 1
         qb = min(cfg.query_block, round_up(max(nq, 1), 8))
         qpad = round_up(max(nq, 1), qb)
-        q_attrs = np.zeros((qpad, inp.params.num_attrs), np.float64)
+        q_attrs = np.zeros((qpad, inp.params.num_attrs), np.float32)
         q_attrs[:nq] = inp.query_attrs
         ks_pad = np.zeros(qpad, np.int32)
         ks_pad[:nq] = inp.ks
 
-        preds, rids, rd = [], [], []
-        for q0 in range(0, qpad, qb):
-            p, i, d = _full_block(
-                d_attrs, d_labels, d_ids,
-                jnp.asarray(q_attrs[q0:q0 + qb], self._dtype),
-                jnp.asarray(ks_pad[q0:q0 + qb]),
-                k=k, data_block=data_block, num_labels=num_labels)
-            preds.append(np.asarray(p)); rids.append(np.asarray(i)); rd.append(np.asarray(d, np.float64))
-        preds = np.concatenate(preds)[:nq]
-        rids = np.concatenate(rids)[:nq]
-        rd = np.concatenate(rd)[:nq]
+        nb = qpad // qb
+        p, i, d = _full_blocks(
+            d_attrs, d_labels, d_ids,
+            jnp.asarray(q_attrs.reshape(nb, qb, -1), self._dtype),
+            jnp.asarray(ks_pad.reshape(nb, qb)),
+            k=k, data_block=data_block, num_labels=num_labels,
+            select=select)
+        preds = np.asarray(p).reshape(qpad)[:nq]
+        rids = np.asarray(i).reshape(qpad, -1)[:nq]
+        rd = np.asarray(d, np.float64).reshape(qpad, -1)[:nq]
         return [QueryResult(qi, int(inp.ks[qi]), int(preds[qi]),
                             rids[qi, : int(inp.ks[qi])].astype(np.int64),
                             rd[qi, : int(inp.ks[qi])])

@@ -73,7 +73,8 @@ def merge_topk(a: TopK, b: TopK, k: int) -> TopK:
 
 def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
                    data_labels: jax.Array, data_ids: jax.Array, k: int,
-                   data_block: int, accum_dtype=jnp.float32) -> TopK:
+                   data_block: int, accum_dtype=jnp.float32,
+                   select: str = "sort") -> TopK:
     """Top-k nearest data points per query, streaming over data blocks.
 
     Computes (Qb x data_block) distance tiles one block at a time and folds
@@ -84,6 +85,16 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
 
     ``data_attrs`` must be padded to a multiple of ``data_block`` with
     sentinel rows (id = -1); real N may be smaller.
+
+    ``select`` picks the per-step merge: "sort" is the strict total order
+    (reference tie semantics on device); "topk" is a ``lax.top_k`` partial
+    reduce — ~4x faster on TPU, exact by distance, but distance ties keep
+    the lowest *position* instead of the reference's (label desc, id desc)
+    preference. That matters only when a tie group straddles the candidate
+    boundary k: the kept candidates may then exclude the preferred ones, a
+    loss no downstream rescore can undo. Engines detect that hazard on host
+    (dmlp_tpu.engine.finalize.boundary_overflow) and recompute affected
+    queries exactly, so either path yields golden parity.
     """
     from dmlp_tpu.ops.distance import masked_pairwise_sq_l2
 
@@ -101,7 +112,7 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
         jnp.full((qb, k), -1, jnp.int32),
         jnp.full((qb, k), -1, jnp.int32))
 
-    def step(carry: TopK, blk):
+    def step_sort(carry: TopK, blk):
         battrs, blabels, bids = blk
         tile = masked_pairwise_sq_l2(query_attrs, battrs, bids, accum_dtype)
         cand = TopK(tile,
@@ -109,5 +120,26 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
                     jnp.broadcast_to(bids[None, :], tile.shape))
         return merge_topk(carry, cand, k), None
 
+    def step_topk(carry: TopK, blk):
+        battrs, blabels, bids = blk
+        tile = masked_pairwise_sq_l2(query_attrs, battrs, bids, accum_dtype)
+        alld = jnp.concatenate([carry.dists, tile], axis=-1)
+        negd, idx = jax.lax.top_k(-alld, k)
+        # Entry idx < k came from the carry, else from the block — gather
+        # metadata from whichever side without materializing (Qb, B) labels.
+        from_carry = idx < k
+        cidx = jnp.minimum(idx, k - 1)
+        bidx = jnp.maximum(idx - k, 0)
+        new_labels = jnp.where(
+            from_carry, jnp.take_along_axis(carry.labels, cidx, axis=-1),
+            blabels[bidx])
+        new_ids = jnp.where(
+            from_carry, jnp.take_along_axis(carry.ids, cidx, axis=-1),
+            bids[bidx])
+        return TopK(-negd, new_labels, new_ids), None
+
+    if select not in ("sort", "topk"):
+        raise ValueError(f"unknown select {select!r}")
+    step = step_sort if select == "sort" else step_topk
     out, _ = jax.lax.scan(step, init, blocks)
     return out

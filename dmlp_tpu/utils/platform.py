@@ -1,0 +1,27 @@
+"""Platform bootstrap: honor JAX_PLATFORMS=cpu in this container.
+
+This container routes JAX to a tunneled TPU via an ``axon`` sitecustomize
+hook that registers an extra PJRT backend factory at interpreter start; that
+factory wins over ``JAX_PLATFORMS=cpu``, so virtual-device CPU runs (the
+multi-chip test/dry-run path, survey §4) would silently land on the one real
+chip. Call :func:`honor_cpu_request` before the first backend touch to drop
+the hook when the caller asked for CPU.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_cpu_request() -> None:
+    """If JAX_PLATFORMS=cpu is set, make sure it wins (idempotent).
+
+    Must run before any JAX backend is initialized; a no-op otherwise.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+        return
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    jax.config.update("jax_platforms", "cpu")
+    _xb._backend_factories.pop("axon", None)

@@ -18,10 +18,8 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
-import jax  # noqa: E402
-from jax._src import xla_bridge as _xb  # noqa: E402
-
 # The hook may have latched jax_platforms=axon into jax.config before this
 # file ran; both the config and the factory must go.
-jax.config.update("jax_platforms", "cpu")
-_xb._backend_factories.pop("axon", None)
+from dmlp_tpu.utils.platform import honor_cpu_request  # noqa: E402
+
+honor_cpu_request()

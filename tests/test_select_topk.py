@@ -1,0 +1,82 @@
+"""The fast "topk" selection path: parity incl. adversarial tie overflow.
+
+The ``lax.top_k`` path keeps distance ties by position, not by the
+reference's (label desc, id desc) preference (dmlp_tpu.ops.topk). These
+tests force ``select="topk"`` (every other test resolves "auto" -> "sort"
+at test sizes) and cover the case code review flagged: a duplicate tie
+group larger than k + margin straddling the candidate boundary, where the
+candidate set itself is wrong and only the boundary_overflow repair can
+restore golden parity.
+"""
+
+import numpy as np
+import pytest
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.engine.finalize import boundary_overflow
+from dmlp_tpu.engine.sharded import RingEngine, ShardedEngine
+from dmlp_tpu.engine.single import SingleChipEngine
+from dmlp_tpu.golden.reference import knn_golden
+from dmlp_tpu.io.datagen import generate_input_text
+from dmlp_tpu.io.grammar import KNNInput, Params, parse_input_text
+from dmlp_tpu.parallel.mesh import make_mesh
+
+from tests.test_engine_single import assert_same_results
+
+
+def duplicate_overflow_input():
+    """32 copies of the queried point (k=5, margin 16 -> width 24 < 32):
+    the fast path's candidate set cannot hold the full tie group."""
+    rng = np.random.default_rng(1)
+    far = rng.uniform(50, 60, size=(32, 4))
+    near = np.tile(np.array([[1.0, 2.0, 3.0, 4.0]]), (32, 1))
+    data = np.concatenate([near, far])
+    labels = np.concatenate([np.arange(32) % 7,
+                             np.zeros(32)]).astype(np.int32)
+    queries = np.array([[1.0, 2.0, 3.0, 4.0]])
+    ks = np.array([5], np.int32)
+    return KNNInput(Params(64, 1, 4), labels, data, ks, queries)
+
+
+@pytest.mark.parametrize("exact", [True, False])
+def test_single_topk_tie_overflow_repair(exact):
+    inp = duplicate_overflow_input()
+    eng = SingleChipEngine(EngineConfig(select="topk", exact=exact,
+                                        data_block=16, query_block=8))
+    assert_same_results(eng.run(inp), knn_golden(inp), check_dists=exact)
+
+
+def test_overflow_detector_flags_tie_at_boundary():
+    d = np.array([[0.0, 1.0, 2.0, 2.0]], np.float32)
+    assert boundary_overflow(d, np.array([3])).tolist() == [True]
+    assert boundary_overflow(d, np.array([2])).tolist() == [False]
+    # +inf tail = candidate list not even full: nothing truncated.
+    dinf = np.array([[0.0, 1.0, np.inf, np.inf]], np.float32)
+    assert boundary_overflow(dinf, np.array([4])).tolist() == [False]
+
+
+def test_single_topk_matches_golden_continuous():
+    text = generate_input_text(700, 60, 6, -5, 5, 1, 20, 4, seed=31)
+    inp = parse_input_text(text)
+    eng = SingleChipEngine(EngineConfig(select="topk", data_block=64,
+                                        query_block=16))
+    assert_same_results(eng.run(inp), knn_golden(inp))
+
+
+@pytest.mark.parametrize("cls,mode", [(ShardedEngine, "sharded"),
+                                      (RingEngine, "ring")])
+def test_mesh_topk_tie_overflow_repair(cls, mode):
+    inp = duplicate_overflow_input()
+    eng = cls(EngineConfig(mode=mode, select="topk", data_block=8,
+                           query_block=8), mesh=make_mesh())
+    assert_same_results(eng.run(inp), knn_golden(inp))
+
+
+@pytest.mark.parametrize("cls,mode", [(ShardedEngine, "sharded"),
+                                      (RingEngine, "ring")])
+def test_mesh_topk_matches_golden_continuous(cls, mode):
+    text = generate_input_text(400, 40, 5, 0, 10, 1, 16, 6, seed=13)
+    inp = parse_input_text(text)
+    eng = cls(EngineConfig(mode=mode, select="topk", data_block=16,
+                           query_block=8), mesh=make_mesh())
+    assert_same_results(eng.run(inp), knn_golden(inp))
