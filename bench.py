@@ -2,9 +2,12 @@
 
 Workload: the reference's headline benchmark shape — brute-force KNN
 classification (survey §6). The timed region matches the reference's
-(common.cpp:122-131 brackets only Engine::KNN, after ingest): device solve
-only, compile excluded (XLA compiles once per shape; the reference pays no
-JIT either).
+(common.cpp:122-131 brackets Engine::KNN after stdin ingest): everything
+the reference's timed call does — distribution (host staging + transfer,
+the scatter analog), device solve, and result finalization — via the same
+``engine.run()`` pipeline for every mode. Parsing/generation is outside;
+compile is excluded via a warmup call (XLA compiles once per shape; the
+reference pays no JIT either).
 
 Baseline: a blocked NumPy (BLAS f32) implementation of the same solve on the
 host CPU — the portable stand-in for the reference's CPU/MPI engine, whose
@@ -76,7 +79,7 @@ def time_engine_ms(inp, mode: str, repeats: int) -> float:
                        query_block=2048)
     engine = make_engine(cfg)
 
-    run = (engine.run_device_full if mode == "single" else engine.run)
+    run = engine.run  # same pipeline for every mode -> comparable numbers
     run(inp)  # warmup: compile + first dispatch
     times = []
     for _ in range(repeats):

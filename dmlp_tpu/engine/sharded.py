@@ -103,11 +103,13 @@ class ShardedEngine:
         if cfg.data_block is not None:
             data_block = min(cfg.data_block, shard_rows_est)
         else:
-            data_block, _ = fit_blocks(max(-(-n // r), 1),
-                                       cfg.resolve_data_block(select))
+            data_block = fit_blocks(max(-(-n // r), 1),
+                                    cfg.resolve_data_block(select))
         d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(inp, data_block)
         kmax = int(inp.ks.max()) if inp.params.num_queries else 1
         extra = cfg.margin if cfg.exact else 0
+        if select == "topk":
+            extra = max(extra, 8)  # detector slack, see single._prep
         shard_rows = d_attrs.shape[0] // r
         k = max(min(round_up(kmax + extra, 8), shard_rows * r), kmax)
 
@@ -123,10 +125,11 @@ class ShardedEngine:
         dists, labels, ids = self.candidates(inp)
         results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
                                 inp.data_attrs, exact=self.config.exact)
-        if self._last_select == "topk":
+        if self._last_select == "topk" and dists.shape[1] < inp.params.num_data:
             # Per-shard truncation of a tie group surfaces as the same
             # boundary equality on the merged lists (the tie value fills the
-            # tail), so one detector covers both engines.
+            # tail), so one detector covers both engines. width >= num_data
+            # means every real point is a candidate — nothing truncated.
             suspects = np.nonzero(boundary_overflow(dists, inp.ks))[0]
             if suspects.size:
                 repair_boundary_overflow(results, suspects, inp)

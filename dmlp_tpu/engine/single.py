@@ -39,9 +39,9 @@ def round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def fit_blocks(n: int, target_block: int) -> Tuple[int, int]:
-    """(data_block, npad) with npad % data_block == 0, data_block % 8 == 0,
-    data_block <= ~target_block, and padding waste < 8 * nblocks rows.
+def fit_blocks(n: int, target_block: int) -> int:
+    """A data_block (multiple of 8, <= ~target_block) whose round_up padding
+    wastes < 8 * nblocks rows of n.
 
     Plain round_up(n, target_block) can waste up to target_block - 1 rows
     (31% at n=200k, target=64k) — real compute, since padded rows still ride
@@ -50,8 +50,7 @@ def fit_blocks(n: int, target_block: int) -> Tuple[int, int]:
     """
     n = max(n, 1)
     nblocks = max(1, -(-n // max(target_block, 8)))
-    block = round_up(-(-n // nblocks), 8)
-    return block, block * nblocks
+    return round_up(-(-n // nblocks), 8)
 
 
 def pad_dataset(inp: KNNInput, multiple: int, dtype: np.dtype
@@ -121,10 +120,15 @@ class SingleChipEngine:
         if cfg.data_block is not None:
             data_block = min(cfg.data_block, round_up(max(n, 1), 8))
         else:
-            data_block, _ = fit_blocks(n, cfg.resolve_data_block(select))
+            data_block = fit_blocks(n, cfg.resolve_data_block(select))
         attrs, labels, ids = pad_dataset(inp, data_block, np.float32)
         kmax = int(inp.ks.max()) if inp.params.num_queries else 1
         extra = cfg.margin if cfg.exact else 0
+        if select == "topk":
+            # The tie-overflow detector needs ks < kcap slack: with zero
+            # extra slots the k-th and last candidate coincide and every
+            # query would be flagged (degenerate all-repair).
+            extra = max(extra, 8)
         k = min(round_up(kmax + extra, 8), attrs.shape[0])
         k = max(k, kmax)  # never below the widest query's k
         d_attrs = jnp.asarray(attrs, self._dtype)
@@ -161,7 +165,9 @@ class SingleChipEngine:
         dists, labels, ids = self.candidates(inp)
         results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
                                 inp.data_attrs, exact=self.config.exact)
-        if self._last_select == "topk":
+        if self._last_select == "topk" and dists.shape[1] < inp.params.num_data:
+            # (width >= num_data means every real point is a candidate —
+            # nothing can have been truncated.)
             suspects = np.nonzero(boundary_overflow(dists, inp.ks))[0]
             if suspects.size:
                 repair_boundary_overflow(results, suspects, inp)

@@ -1,0 +1,14 @@
+"""Training extension — the north-star data/tensor-parallel train loop.
+
+The reference repo's distributed substrate (2D MPI grid + collectives) only
+ever served a KNN solve; BASELINE.json's north star asks for the same
+substrate carrying a training loop (fwd/bwd matmuls + optimizer, gradient
+all-reduce over the mesh). This package provides that, TPU-native: a
+pure-JAX MLP classifier, a jitted train step whose gradient sync is
+declarative (param shardings make XLA insert the dp all-reduce — the
+MPI_Allreduce analog), optax optimizers, orbax checkpoint/resume, and
+samples/sec/chip + MFU metrics.
+"""
+
+from dmlp_tpu.train.model import init_mlp, mlp_apply  # noqa: F401
+from dmlp_tpu.train.step import TrainState, make_train_step  # noqa: F401

@@ -1,0 +1,53 @@
+"""Checkpoint / resume via orbax (survey §5.4 — absent in the reference).
+
+The reference has no checkpointing at all; its only "resume" is benchmark
+output caching (run_bench.sh:79-84). The training extension gets real
+save/restore: the TrainState pytree (params, optimizer moments, step
+counter) round-trips through orbax, preserving shardings on restore when a
+target template is supplied.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(directory: str, state: Any, step: Optional[int] = None,
+                    ) -> str:
+    """Write ``state`` under directory/step_<n>; returns the path."""
+    if step is None:
+        step = int(jax.device_get(state["step"]))
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    ckpt = _checkpointer()
+    ckpt.save(path, state, force=True)
+    ckpt.wait_until_finished()
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = [int(name[5:]) for name in os.listdir(directory)
+             if name.startswith("step_") and name[5:].isdigit()]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(directory: str, target: Any,
+                       step: Optional[int] = None) -> Any:
+    """Restore the given (or latest) step. ``target`` is a state template
+    with the desired shapes/dtypes/shardings (e.g. a freshly built
+    TrainState); restored arrays adopt its placement."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    return _checkpointer().restore(path, target=target)

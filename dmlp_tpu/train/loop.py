@@ -1,0 +1,125 @@
+"""Training-loop CLI: the north-star benchmark entry point.
+
+Runs the dp x tp sharded train step over a device mesh with JSON metrics
+(samples/sec/chip, MFU — BASELINE.json's metric set) and orbax
+checkpoint/resume. Usage::
+
+    python -m dmlp_tpu.train.loop --steps 200 --batch 4096 \
+        --dims 64,512,512,10 [--mesh DP,TP] [--optimizer sgd|adam]
+        [--compute-dtype bfloat16] [--checkpoint-dir ckpt --ckpt-every 100]
+        [--resume] [--metrics-file metrics.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dmlp_tpu.train import checkpoint as ckpt_lib
+from dmlp_tpu.train.data import teacher_batches
+from dmlp_tpu.train.metrics import throughput_metrics
+from dmlp_tpu.train.model import init_mlp
+from dmlp_tpu.train.sharding import batch_shardings, make_train_mesh, param_shardings
+from dmlp_tpu.train.step import init_state, make_optimizer, make_train_step
+from dmlp_tpu.utils.metrics_log import MetricsLogger
+
+
+def build_sharded_state(mesh, dims, optimizer, seed: int = 0):
+    """Init params on host, place them with the tp/dp shardings, then build
+    the optimizer state on the placed params so moments inherit placement."""
+    params = init_mlp(jax.random.PRNGKey(seed), dims)
+    placed = jax.tree.map(
+        lambda p, s: jax.device_put(p, s), params,
+        param_shardings(params, mesh))
+    return init_state(placed, optimizer)
+
+
+def train(steps: int = 100, batch: int = 1024,
+          dims: Sequence[int] = (64, 256, 256, 10),
+          mesh_shape=None, optimizer_name: str = "sgd", lr: float = 1e-2,
+          compute_dtype: Optional[str] = None, seed: int = 0,
+          checkpoint_dir: Optional[str] = None, ckpt_every: int = 100,
+          resume: bool = False, metrics: Optional[MetricsLogger] = None,
+          log_every: int = 10):
+    mesh = make_train_mesh(mesh_shape)
+    n_chips = mesh.devices.size
+    optimizer = make_optimizer(optimizer_name, lr)
+    state = build_sharded_state(mesh, dims, optimizer, seed)
+    start_step = 0
+    if resume and checkpoint_dir and ckpt_lib.latest_step(checkpoint_dir) is not None:
+        state = ckpt_lib.restore_checkpoint(checkpoint_dir, state)
+        start_step = int(jax.device_get(state["step"]))
+
+    cdtype = jnp.bfloat16 if compute_dtype == "bfloat16" else None
+    step_fn = make_train_step(optimizer, cdtype)
+    xsh, ysh = batch_shardings(mesh)
+    data = teacher_batches(dims[0], dims[-1], batch, seed=seed + 1)
+
+    last = {}
+    t_window = time.perf_counter()
+    for i in range(start_step, start_step + steps):
+        x, y = next(data)
+        xd = jax.device_put(x, xsh)
+        yd = jax.device_put(y, ysh)
+        state, m = step_fn(state, xd, yd)
+        if (i + 1) % log_every == 0 or i + 1 == start_step + steps:
+            m = jax.device_get(m)
+            dt = (time.perf_counter() - t_window) / log_every
+            t_window = time.perf_counter()
+            last = {"step": i + 1, "loss": float(m["loss"]),
+                    "accuracy": float(m["accuracy"]),
+                    **throughput_metrics(state["params"], batch, dt, n_chips)}
+            if metrics is not None:
+                metrics.log(**last)
+        if checkpoint_dir and (i + 1) % ckpt_every == 0:
+            ckpt_lib.save_checkpoint(checkpoint_dir, state, step=i + 1)
+    if checkpoint_dir:
+        ckpt_lib.save_checkpoint(checkpoint_dir, state,
+                                 step=start_step + steps)
+    return state, last
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="dmlp_tpu.train", description=__doc__)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch", type=int, default=1024)
+    p.add_argument("--dims", type=str, default="64,256,256,10",
+                   help="comma-separated layer dims: in,hidden...,classes")
+    p.add_argument("--mesh", type=str, default=None, help="DP,TP")
+    p.add_argument("--optimizer", default="sgd", choices=["sgd", "adam"])
+    p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--compute-dtype", default=None,
+                   choices=[None, "bfloat16"])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--ckpt-every", type=int, default=100)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--metrics-file", default=None)
+    p.add_argument("--log-every", type=int, default=10)
+    args = p.parse_args(argv)
+
+    mesh_shape = None
+    if args.mesh:
+        dp, tp = args.mesh.split(",")
+        mesh_shape = (int(dp), int(tp))
+    metrics = MetricsLogger(path=args.metrics_file) \
+        if args.metrics_file else MetricsLogger()
+    _, last = train(
+        steps=args.steps, batch=args.batch,
+        dims=tuple(int(d) for d in args.dims.split(",")),
+        mesh_shape=mesh_shape, optimizer_name=args.optimizer, lr=args.lr,
+        compute_dtype=args.compute_dtype, seed=args.seed,
+        checkpoint_dir=args.checkpoint_dir, ckpt_every=args.ckpt_every,
+        resume=args.resume, metrics=metrics, log_every=args.log_every)
+    print(f"final: {last}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

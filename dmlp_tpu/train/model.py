@@ -1,0 +1,61 @@
+"""Pure-JAX MLP classifier over the framework's attribute-vector data model.
+
+The model consumes the same record shape the KNN engine does — a float
+attribute vector per example, an integer label — so the training extension
+and the parity engine share one data pipeline (io.grammar / io.datagen).
+Params are a plain pytree (dict of layers), which keeps sharding annotations
+(train.sharding) and orbax checkpointing trivially composable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Dict[str, jax.Array]]
+
+
+def init_mlp(key: jax.Array, layer_dims: Sequence[int],
+             dtype=jnp.float32) -> Params:
+    """He-initialized MLP params for dims [in, h1, ..., num_classes]."""
+    params: Params = {}
+    keys = jax.random.split(key, len(layer_dims) - 1)
+    for i, (din, dout) in enumerate(zip(layer_dims[:-1], layer_dims[1:])):
+        params[f"layer{i}"] = {
+            "w": (jax.random.normal(keys[i], (din, dout), dtype)
+                  * jnp.sqrt(2.0 / din).astype(dtype)),
+            "b": jnp.zeros((dout,), dtype),
+        }
+    return params
+
+
+def mlp_apply(params: Params, x: jax.Array,
+              compute_dtype=None) -> jax.Array:
+    """Forward pass -> logits (..., num_classes).
+
+    ``compute_dtype=bfloat16`` runs the matmuls on the MXU in bf16 with f32
+    accumulation (preferred_element_type); params stay in their storage
+    dtype, logits are returned in f32 for a stable softmax.
+    """
+    n = len(params)
+    h = x if compute_dtype is None else x.astype(compute_dtype)
+    for i in range(n):
+        layer = params[f"layer{i}"]
+        w, b = layer["w"], layer["b"]
+        if compute_dtype is not None:
+            w = w.astype(compute_dtype)
+        h = jax.lax.dot_general(h, w, (((h.ndim - 1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        h = h + b.astype(h.dtype)
+        if i < n - 1:
+            h = jax.nn.relu(h)
+            if compute_dtype is not None:
+                h = h.astype(compute_dtype)
+    return h
+
+
+def num_matmul_params(params: Any) -> int:
+    """Total weight-matrix elements (for the 6*N*B FLOP estimate)."""
+    return sum(int(v["w"].size) for v in params.values())

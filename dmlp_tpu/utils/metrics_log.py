@@ -1,0 +1,34 @@
+"""Structured JSON metrics (survey §5.5 gap).
+
+The reference's observability is two text channels: results on stdout,
+one ``Time taken`` line on stderr. That contract stays untouched
+(utils.timing); this logger adds the optional structured channel the
+driver metadata asks for — one JSON object per line, appendable to a file
+or any stream.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import IO, Optional
+
+
+class MetricsLogger:
+    """Writes one JSON line per record; values must be JSON-serializable."""
+
+    def __init__(self, path: Optional[str] = None, stream: Optional[IO] = None):
+        if path is not None:
+            self._fh: IO = open(path, "a")
+            self._owns = True
+        else:
+            self._fh = stream if stream is not None else sys.stderr
+            self._owns = False
+
+    def log(self, **record) -> None:
+        self._fh.write(json.dumps(record, sort_keys=True) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._owns:
+            self._fh.close()

@@ -55,6 +55,20 @@ def test_overflow_detector_flags_tie_at_boundary():
     assert boundary_overflow(dinf, np.array([4])).tolist() == [False]
 
 
+def test_fast_mode_topk_keeps_detector_slack():
+    # Regression (code review): with exact=False the margin used to be 0,
+    # making ks == kcap and the overflow detector flag *every* query —
+    # the "fast" path then ran the host oracle on the whole problem. The
+    # topk path must always carry extra candidate slots.
+    text = generate_input_text(300, 30, 6, -5, 5, 4, 12, 4, seed=2)
+    inp = parse_input_text(text)
+    eng = SingleChipEngine(EngineConfig(select="topk", exact=False,
+                                        data_block=64, query_block=8))
+    dists, _, _ = eng.candidates(inp)
+    assert dists.shape[1] > int(inp.ks.max())
+    assert not boundary_overflow(dists, inp.ks).any()
+
+
 def test_single_topk_matches_golden_continuous():
     text = generate_input_text(700, 60, 6, -5, 5, 1, 20, 4, seed=31)
     inp = parse_input_text(text)
