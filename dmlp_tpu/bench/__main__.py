@@ -1,0 +1,5 @@
+from dmlp_tpu.bench.harness import main
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Benchmark config registry — configs 1-4, like run_bench.sh:77-123.
+
+The reference maps each config to SLURM hardware constraints, an MPI task
+count, and a canonical input (missing from the snapshot, so regenerated
+seeded — survey §6 "step 0"). Here each config maps to generator
+parameters, an engine mode, and a mesh hint; scale steps up like the
+BASELINE.json ladder (CPU-ish -> v4-8 -> v4-32 -> v5p analog). Sizes are
+chosen so config 1 finishes quickly anywhere and config 4 stresses a real
+chip; the oracle is the golden model, cached after first run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchConfig:
+    """One benchmark configuration (the analog of a run_bench.sh block)."""
+
+    config_id: int
+    # generator args (generate_input.py grammar, seeded)
+    num_data: int
+    num_queries: int
+    num_attrs: int
+    min_attr: float
+    max_attr: float
+    min_k: int
+    max_k: int
+    num_labels: int
+    seed: int
+    input_name: str          # shared inputs, like input2.in serving configs 2+3
+    mode: str = "single"     # engine mode to benchmark
+    mesh_shape: Optional[Tuple[int, int]] = None
+
+
+BENCH_CONFIGS: Dict[int, BenchConfig] = {
+    1: BenchConfig(1, 20_000, 1_000, 32, 0.0, 100.0, 1, 16, 10, 42,
+                   "input1.in"),
+    2: BenchConfig(2, 100_000, 5_000, 64, 0.0, 100.0, 1, 32, 10, 42,
+                   "input2.in"),
+    3: BenchConfig(3, 100_000, 5_000, 64, 0.0, 100.0, 1, 32, 10, 42,
+                   "input2.in", mode="sharded"),
+    4: BenchConfig(4, 200_000, 10_000, 64, 0.0, 100.0, 1, 32, 10, 42,
+                   "input3.in"),
+}

@@ -1,0 +1,201 @@
+"""Benchmark orchestration: generate -> oracle (cached) -> engine -> compare.
+
+The TPU-native run_bench.sh. Per config (configs.py):
+
+1. regenerate the canonical input if missing (seeded, byte-stable —
+   inputs/inputN.in, the reference's missing inputs protocol, survey §6);
+2. run the oracle once and cache outputs/test_N.{out,err}
+   (run_bench.sh:79-84's cache), using the fast-exact golden model in
+   place of the unrunnable x86 oracle binaries;
+3. run the engine via the real CLI entry (same stdin/stdout/stderr
+   contract as `mpirun ./engine < input`), writing outputs/tmp.{out,err};
+4. diff the checksum channel (correctness) and compare the `Time taken`
+   lines (performance) in run_bench.sh:29-72's report format.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import time
+from typing import Optional, TextIO
+
+from dmlp_tpu.bench.configs import BENCH_CONFIGS, BenchConfig
+
+
+def _extract_ms(err_text: str) -> Optional[int]:
+    m = re.search(r"Time taken:\s*(\d+)", err_text)
+    return int(m.group(1)) if m else None
+
+
+def compare_times(bench_err: str, engine_err: str, out: TextIO) -> Optional[float]:
+    """The compare_times report (run_bench.sh:29-72); returns percent diff
+    (positive = engine slower), or None if a timing line is missing."""
+    bench_ms = _extract_ms(bench_err)
+    engine_ms = _extract_ms(engine_err)
+    if bench_ms is None or engine_ms is None:
+        out.write("Error: Could not extract timing information from .err files.\n")
+        return None
+    out.write("\n=== Performance Comparison ===\n")
+    out.write(f"Benchmark time: {bench_ms} ms\n")
+    out.write(f"Engine time:    {engine_ms} ms\n")
+    diff = engine_ms - bench_ms
+    if bench_ms == 0:
+        # Oracle rounded to 0 ms — a percentage would be meaningless (and
+        # claiming 0% would falsely declare parity).
+        out.write(f"Difference:     +{diff} ms (oracle < 1 ms; no %)\n")
+        out.write("==============================\n\n")
+        return None
+    percent = (engine_ms - bench_ms) / bench_ms * 100.0
+    if percent > 0:
+        out.write(f"Difference:     +{abs(diff)} ms ({percent:.2f}% slower)\n")
+    elif percent < 0:
+        out.write(f"Difference:     -{abs(diff)} ms ({abs(percent):.2f}% "
+                  "faster) \U0001f389\U0001f389\U0001f389\n")
+    else:
+        out.write("Difference:     0 ms (No difference)\n")
+    out.write("==============================\n\n")
+    return percent
+
+
+def ensure_input(cfg: BenchConfig, inputs_dir: str) -> str:
+    """Generate the config's seeded input if not cached; returns the path."""
+    from dmlp_tpu.io.datagen import generate_input_text
+
+    os.makedirs(inputs_dir, exist_ok=True)
+    path = os.path.join(inputs_dir, cfg.input_name)
+    if not os.path.exists(path):
+        text = generate_input_text(cfg.num_data, cfg.num_queries,
+                                   cfg.num_attrs, cfg.min_attr, cfg.max_attr,
+                                   cfg.min_k, cfg.max_k, cfg.num_labels,
+                                   seed=cfg.seed)
+        with open(path, "w") as f:
+            f.write(text)
+    return path
+
+
+def ensure_oracle(cfg: BenchConfig, input_path: str, outputs_dir: str,
+                  out: TextIO, force: bool = False) -> tuple[str, str]:
+    """Run the golden oracle (cached) for a config; returns (.out, .err) paths."""
+    from dmlp_tpu.golden.fast import knn_golden_fast
+    from dmlp_tpu.io.grammar import parse_input_text
+    from dmlp_tpu.io.report import format_results
+    from dmlp_tpu.utils.timing import format_time_taken
+
+    os.makedirs(outputs_dir, exist_ok=True)
+    out_path = os.path.join(outputs_dir, f"test_{cfg.config_id}.out")
+    err_path = os.path.join(outputs_dir, f"test_{cfg.config_id}.err")
+    if os.path.exists(err_path) and os.path.exists(out_path) and not force:
+        out.write("Output found in cache. Skipping...\n")
+        return out_path, err_path
+    with open(input_path) as f:
+        inp = parse_input_text(f.read())
+    t0 = time.perf_counter()
+    stats: dict = {}
+    results = knn_golden_fast(inp, stats=stats)
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    if stats.get("fallbacks"):
+        out.write(f"oracle: {stats['fallbacks']} queries took the strict "
+                  "fallback path\n")
+    with open(out_path, "w") as f:
+        f.write(format_results(results))
+    with open(err_path, "w") as f:
+        f.write(format_time_taken(elapsed_ms))
+    return out_path, err_path
+
+
+def run_engine(cfg: BenchConfig, input_path: str, outputs_dir: str,
+               mode: Optional[str] = None, fast: bool = False,
+               warmup: bool = True) -> tuple[str, str]:
+    """Run the engine CLI on the input; returns (tmp.out, tmp.err) paths.
+
+    Defaults to exact (f64-parity) mode — the harness exists to prove
+    checksum parity, like the reference's oracle diff; ``fast=True`` drops
+    the host rescore for pure-device timing at the cost of f32 ordering.
+    """
+    from dmlp_tpu.cli import main as cli_main
+
+    argv = ["--mode", mode or cfg.mode]
+    if fast:
+        argv.append("--fast")
+    if warmup:
+        argv.append("--warmup")
+    out_buf, err_buf = io.StringIO(), io.StringIO()
+    with open(input_path) as stdin:
+        rc = cli_main(argv, stdin=stdin, stdout=out_buf, stderr=err_buf)
+    if rc != 0:
+        raise RuntimeError(f"engine CLI exited {rc}")
+    tmp_out = os.path.join(outputs_dir, "tmp.out")
+    tmp_err = os.path.join(outputs_dir, "tmp.err")
+    with open(tmp_out, "w") as f:
+        f.write(out_buf.getvalue())
+    with open(tmp_err, "w") as f:
+        f.write(err_buf.getvalue())
+    return tmp_out, tmp_err
+
+
+def run_config(config_id: int, base_dir: str = ".",
+               mode: Optional[str] = None, fast: bool = False,
+               force_oracle: bool = False, out: Optional[TextIO] = None,
+               ) -> dict:
+    """Full benchmark flow for one config; returns a result summary dict."""
+    import sys
+
+    out = out or sys.stdout
+    cfg = BENCH_CONFIGS[config_id]
+    inputs_dir = os.path.join(base_dir, "inputs")
+    outputs_dir = os.path.join(base_dir, "outputs")
+
+    input_path = ensure_input(cfg, inputs_dir)
+    oracle_out, oracle_err = ensure_oracle(cfg, input_path, outputs_dir, out,
+                                           force=force_oracle)
+    engine_out, engine_err = run_engine(cfg, input_path, outputs_dir,
+                                        mode=mode, fast=fast)
+
+    with open(oracle_out) as f:
+        want = f.read()
+    with open(engine_out) as f:
+        got = f.read()
+    checksums_match = want == got
+    status = "PASS" if checksums_match else "FAIL"
+    out.write(f"Config {config_id}: checksums {status} "
+              f"({cfg.num_queries} queries)\n")
+
+    with open(oracle_err) as f:
+        oe = f.read()
+    with open(engine_err) as f:
+        ee = f.read()
+    percent = compare_times(oe, ee, out)
+    return {"config": config_id, "checksums_match": checksums_match,
+            "oracle_ms": _extract_ms(oe), "engine_ms": _extract_ms(ee),
+            "percent_vs_oracle": percent}
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(prog="dmlp_tpu.bench", description=__doc__)
+    p.add_argument("config", help="1|2|3|4|all")
+    p.add_argument("--mode", default=None,
+                   choices=[None, "single", "sharded", "ring"])
+    p.add_argument("--fast", action="store_true",
+                   help="drop the f64 host rescore (f32 ordering; checksum "
+                        "diffs vs the f64 oracle are then expected)")
+    p.add_argument("--force-oracle", action="store_true")
+    p.add_argument("--base-dir", default=".")
+    args = p.parse_args(argv)
+
+    ids = list(BENCH_CONFIGS) if args.config == "all" else [int(args.config)]
+    ok = True
+    for cid in ids:
+        res = run_config(cid, base_dir=args.base_dir, mode=args.mode,
+                         fast=args.fast, force_oracle=args.force_oracle)
+        ok = ok and res["checksums_match"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -53,6 +53,30 @@ def vote(labels: np.ndarray) -> int:
     return int(uniq[counts == best].max())
 
 
+def finalize_query(drow: np.ndarray, labels: np.ndarray, ids: np.ndarray,
+                   k: int, qi: int) -> QueryResult:
+    """Candidate distances for one query -> its final QueryResult.
+
+    THE definition of the output contract, shared by the strict and fast
+    oracles: select by (dist asc, label desc, id desc), vote (tie -> larger
+    label), report order (dist asc, id desc), pad to k with the id = -1 /
+    dist = +inf sentinel (common.cpp:66). ``drow``/``labels``/``ids`` may be
+    the full dataset row or any candidate subset that contains the true
+    top-k.
+    """
+    order = _select_order(drow, labels, ids)[: min(k, drow.shape[0])]
+    sel_d, sel_l, sel_i = drow[order], labels[order], ids[order]
+    predicted = vote(sel_l)
+    ro = np.lexsort((-sel_i, sel_d))
+    out_ids, out_dists = sel_i[ro], sel_d[ro]
+    if out_ids.size < k:
+        pad = k - out_ids.size
+        out_ids = np.concatenate([out_ids, np.full(pad, -1, np.int64)])
+        out_dists = np.concatenate([out_dists, np.full(pad, np.inf)])
+    return QueryResult(qi, k, predicted, out_ids.astype(np.int64),
+                       out_dists.astype(np.float64))
+
+
 def knn_golden(inp: KNNInput, dtype=np.float64,
                query_block: int = 256) -> List[QueryResult]:
     """Solve a problem instance exactly; returns per-query results in id order.
@@ -70,29 +94,20 @@ def knn_golden(inp: KNNInput, dtype=np.float64,
     ids = np.arange(nd, dtype=np.int64)
 
     results: List[QueryResult] = []
+    data_block = 8192  # bounds the (qb, nb, A) diff tensor
     for q0 in range(0, nq, query_block):
         q1 = min(q0 + query_block, nq)
         # Difference form, like computeDistance (engine.cpp:12-18) — exact in
         # the working dtype, unlike the norm+matmul form the device uses.
-        diff = queries[q0:q1, None, :] - data[None, :, :]
-        dists = np.einsum("qna,qna->qn", diff, diff)
+        # Blocked over data too so the diff tensor stays bounded.
+        dists = np.empty((q1 - q0, nd), dtype)
+        for n0 in range(0, nd, data_block):
+            n1 = min(n0 + data_block, nd)
+            diff = queries[q0:q1, None, :] - data[None, n0:n1, :]
+            dists[:, n0:n1] = np.einsum("qna,qna->qn", diff, diff)
         for qi in range(q0, q1):
-            k = int(inp.ks[qi])
-            drow = dists[qi - q0]
-            order = _select_order(drow, labels, ids)[: min(k, nd)]
-            sel_d, sel_l, sel_i = drow[order], labels[order], ids[order]
-            predicted = vote(sel_l)
-            # Report order: dist asc, tie -> larger id (engine.cpp:334-338).
-            ro = np.lexsort((-sel_i, sel_d))
-            out_ids = sel_i[ro]
-            out_dists = sel_d[ro]
-            if out_ids.size < k:  # id=-1 sentinel padding (common.cpp:66)
-                pad = k - out_ids.size
-                out_ids = np.concatenate([out_ids, np.full(pad, -1, np.int64)])
-                out_dists = np.concatenate([out_dists, np.full(pad, np.inf)])
-            results.append(QueryResult(qi, k, predicted,
-                                       out_ids.astype(np.int64),
-                                       out_dists.astype(np.float64)))
+            results.append(finalize_query(dists[qi - q0], labels, ids,
+                                          int(inp.ks[qi]), qi))
     return results
 
 

@@ -36,6 +36,8 @@ def knn_input_batches(inp, batch_size: int, seed: int = 42,
     x_all = np.asarray(inp.data_attrs, np.float32)
     y_all = np.asarray(inp.labels, np.int32)
     n = x_all.shape[0]
+    if batch_size > n:
+        raise ValueError(f"batch_size {batch_size} > {n} data points")
     while True:
         perm = rng.permutation(n)
         for i0 in range(0, n - batch_size + 1, batch_size):

@@ -62,15 +62,18 @@ def train(steps: int = 100, batch: int = 1024,
 
     last = {}
     t_window = time.perf_counter()
+    window_steps = 0
     for i in range(start_step, start_step + steps):
         x, y = next(data)
         xd = jax.device_put(x, xsh)
         yd = jax.device_put(y, ysh)
         state, m = step_fn(state, xd, yd)
+        window_steps += 1
         if (i + 1) % log_every == 0 or i + 1 == start_step + steps:
             m = jax.device_get(m)
-            dt = (time.perf_counter() - t_window) / log_every
+            dt = (time.perf_counter() - t_window) / window_steps
             t_window = time.perf_counter()
+            window_steps = 0
             last = {"step": i + 1, "loss": float(m["loss"]),
                     "accuracy": float(m["accuracy"]),
                     **throughput_metrics(state["params"], batch, dt, n_chips)}

@@ -1,0 +1,73 @@
+"""Bench harness: caching, checksum diff, compare_times format."""
+
+import io
+import os
+
+import pytest
+
+from dmlp_tpu.bench.configs import BenchConfig
+from dmlp_tpu.bench.harness import (compare_times, ensure_input,
+                                    ensure_oracle, run_config)
+
+
+@pytest.fixture()
+def tiny_cfg(monkeypatch):
+    cfg = BenchConfig(1, 200, 20, 4, 0.0, 10.0, 1, 8, 4, 7, "tiny.in")
+    monkeypatch.setitem(
+        __import__("dmlp_tpu.bench.configs",
+                   fromlist=["BENCH_CONFIGS"]).BENCH_CONFIGS, 1, cfg)
+    return cfg
+
+
+def test_input_generation_cached(tiny_cfg, tmp_path):
+    d = str(tmp_path / "inputs")
+    p1 = ensure_input(tiny_cfg, d)
+    mtime = os.path.getmtime(p1)
+    p2 = ensure_input(tiny_cfg, d)
+    assert p1 == p2 and os.path.getmtime(p2) == mtime  # not regenerated
+    with open(p1) as f:
+        assert f.readline().strip() == "200 20 4"
+
+
+def test_oracle_cached(tiny_cfg, tmp_path):
+    inp = ensure_input(tiny_cfg, str(tmp_path / "inputs"))
+    buf = io.StringIO()
+    out1 = ensure_oracle(tiny_cfg, inp, str(tmp_path / "outputs"), buf)
+    assert "cache" not in buf.getvalue()
+    out2 = ensure_oracle(tiny_cfg, inp, str(tmp_path / "outputs"), buf)
+    assert out1 == out2
+    assert "Output found in cache. Skipping...\n" in buf.getvalue()
+
+
+def test_run_config_end_to_end(tiny_cfg, tmp_path):
+    buf = io.StringIO()
+    res = run_config(1, base_dir=str(tmp_path), out=buf)
+    assert res["checksums_match"], buf.getvalue()
+    assert res["oracle_ms"] is not None and res["engine_ms"] is not None
+    text = buf.getvalue()
+    assert "Config 1: checksums PASS" in text
+    assert "=== Performance Comparison ===" in text
+
+
+def test_run_config_exact_mode(tiny_cfg, tmp_path):
+    res = run_config(1, base_dir=str(tmp_path), fast=False,
+                     out=io.StringIO())
+    assert res["checksums_match"]
+
+
+def test_compare_times_report_format():
+    out = io.StringIO()
+    pct = compare_times("Time taken: 100 ms\n", "Time taken: 80 ms\n", out)
+    assert pct == pytest.approx(-20.0)
+    assert "Benchmark time: 100 ms" in out.getvalue()
+    assert "Engine time:    80 ms" in out.getvalue()
+    assert "-20 ms (20.00% faster)" in out.getvalue()
+
+    out = io.StringIO()
+    pct = compare_times("Time taken: 80 ms\n", "Time taken: 100 ms\n", out)
+    assert pct == pytest.approx(25.0)
+    assert "+20 ms (25.00% slower)" in out.getvalue()
+
+    out = io.StringIO()
+    assert compare_times("nope\n", "Time taken: 1 ms\n", out) is None
+    assert "Could not extract timing" in out.getvalue()

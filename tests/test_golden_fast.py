@@ -1,0 +1,49 @@
+"""knn_golden_fast must equal the strict oracle, including under ties."""
+
+import numpy as np
+
+from dmlp_tpu.golden.fast import knn_golden_fast
+from dmlp_tpu.golden.reference import knn_golden
+from dmlp_tpu.io.datagen import generate_input_text
+from dmlp_tpu.io.grammar import KNNInput, Params, parse_input_text
+
+from tests.test_engine_single import assert_same_results
+
+
+def test_fast_golden_matches_strict_continuous():
+    inp = parse_input_text(generate_input_text(2000, 150, 12, -50, 50,
+                                               1, 24, 8, seed=3))
+    assert_same_results(knn_golden_fast(inp), knn_golden(inp))
+
+
+def test_fast_golden_matches_strict_tie_heavy():
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 3, size=(500, 3)).astype(np.float64)
+    queries = rng.integers(0, 3, size=(40, 3)).astype(np.float64)
+    labels = rng.integers(0, 4, size=500).astype(np.int32)
+    ks = rng.integers(1, 30, size=40).astype(np.int32)
+    inp = KNNInput(Params(500, 40, 3), labels, data, ks, queries)
+    assert_same_results(knn_golden_fast(inp), knn_golden(inp))
+
+
+def test_fast_golden_tiny_margin_forces_fallback():
+    # margin=0 means the candidate boundary sits on the k-th entry; the
+    # safety check must route tie-heavy queries to the strict fallback and
+    # still return exact results.
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 2, size=(300, 2)).astype(np.float64)
+    queries = rng.integers(0, 2, size=(20, 2)).astype(np.float64)
+    labels = rng.integers(0, 5, size=300).astype(np.int32)
+    ks = np.full(20, 9, np.int32)
+    inp = KNNInput(Params(300, 20, 2), labels, data, ks, queries)
+    assert_same_results(knn_golden_fast(inp, margin=0), knn_golden(inp))
+
+
+def test_fast_golden_k_exceeds_data():
+    inp = KNNInput(Params(3, 2, 2),
+                   np.array([0, 1, 2], np.int32),
+                   np.array([[0.0, 0], [1, 1], [2, 2]]),
+                   np.array([5, 2], np.int32),
+                   np.array([[0.1, 0.1], [1.5, 1.5]]))
+    assert_same_results(knn_golden_fast(inp), knn_golden(inp),
+                        check_dists=False)
