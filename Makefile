@@ -1,0 +1,25 @@
+# Build system — the analog of the reference's Makefile (mpicxx engine /
+# engine.debug targets). Here the compiled artifact is the native input
+# parser; the engines are JAX programs compiled by XLA at run time.
+
+CXX ?= g++
+CXXFLAGS ?= -O3 -Wall -shared -fPIC
+
+.PHONY: all native test bench clean
+
+all: native
+
+native: native/_fastparse.so
+
+native/_fastparse.so: native/fastparse.cpp
+	$(CXX) $(CXXFLAGS) -o $@ $<
+
+test:
+	python -m pytest tests/ -q
+
+# One-line JSON benchmark on the current backend (TPU under the default env).
+bench:
+	python bench.py
+
+clean:
+	rm -f native/_fastparse.so

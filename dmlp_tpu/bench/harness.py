@@ -79,7 +79,7 @@ def ensure_oracle(cfg: BenchConfig, input_path: str, outputs_dir: str,
                   out: TextIO, force: bool = False) -> tuple[str, str]:
     """Run the golden oracle (cached) for a config; returns (.out, .err) paths."""
     from dmlp_tpu.golden.fast import knn_golden_fast
-    from dmlp_tpu.io.grammar import parse_input_text
+    from dmlp_tpu.io.grammar import parse_input
     from dmlp_tpu.io.report import format_results
     from dmlp_tpu.utils.timing import format_time_taken
 
@@ -89,8 +89,8 @@ def ensure_oracle(cfg: BenchConfig, input_path: str, outputs_dir: str,
     if os.path.exists(err_path) and os.path.exists(out_path) and not force:
         out.write("Output found in cache. Skipping...\n")
         return out_path, err_path
-    with open(input_path) as f:
-        inp = parse_input_text(f.read())
+    with open(input_path, "rb") as f:  # binary -> native parser dispatch
+        inp = parse_input(f)
     t0 = time.perf_counter()
     stats: dict = {}
     results = knn_golden_fast(inp, stats=stats)

@@ -84,9 +84,22 @@ def parse_update(line: str) -> Update:
     return Update(int(toks[0]), np.array([float(t) for t in toks[1:]], dtype=np.float64))
 
 
+_NATIVE_THRESHOLD_BYTES = 1 << 20  # native parser pays off past ~1 MB
+
+
 def parse_input(stream: Union[IO[str], IO[bytes]]) -> KNNInput:
-    """Parse a full problem instance from a text or binary stream."""
+    """Parse a full problem instance from a text or binary stream.
+
+    Large inputs route through the native C++ tokenizer
+    (dmlp_tpu.io.native, bit-identical results) when it is buildable;
+    anything else uses the pure-Python parser below.
+    """
     data = stream.read()
+    if len(data) >= _NATIVE_THRESHOLD_BYTES:
+        from dmlp_tpu.io import native
+        if native.native_available():
+            # bytes pass straight to the C parser — no decode round-trip.
+            return native.parse_input_text_native(data)
     if isinstance(data, bytes):
         data = data.decode("ascii")
     return parse_input_text(data)

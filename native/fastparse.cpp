@@ -1,0 +1,207 @@
+// Native stdin-grammar parser for dmlp_tpu (the TPU-native analog of the
+// reference harness's rank-0 ingest, common.cpp:93-117 + parsers :12-55).
+//
+// The grammar (one header line, num_data data lines, num_queries 'Q' lines,
+// whitespace-tokenized decimals) is parsed straight into caller-allocated
+// flat arrays — the SoA layout the device pipeline feeds — with strtod,
+// which rounds identically to Python's float(), so results are
+// bit-identical to the pure-Python parser (dmlp_tpu.io.grammar).
+//
+// Error contract mirrors common.cpp:101 ("Line is empty") and :114
+// ("Line is wrongly formatted").
+//
+// Build: g++ -O3 -shared -fPIC -o _fastparse.so fastparse.cpp
+// (loaded via ctypes by dmlp_tpu.io.native; no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <locale.h>
+
+namespace {
+
+// strtod is LC_NUMERIC-sensitive; a host app that set a comma-decimal
+// locale would break the fallback path. Parse under a pinned "C" locale.
+locale_t c_locale() {
+    static locale_t loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+    return loc;
+}
+
+struct Cursor {
+    const char* p;
+    const char* end;
+};
+
+inline void skip_spaces(Cursor& c) {
+    while (c.p < c.end && (*c.p == ' ' || *c.p == '\t' || *c.p == '\r'))
+        ++c.p;
+}
+
+// Advance past the current line's newline; returns false at EOF.
+inline bool next_line(Cursor& c) {
+    while (c.p < c.end && *c.p != '\n') ++c.p;
+    if (c.p < c.end) ++c.p;
+    return c.p < c.end;
+}
+
+inline bool at_eol(const Cursor& c) {
+    return c.p >= c.end || *c.p == '\n';
+}
+
+// Parse an integer token. Strict like Python's int(): the token must end at
+// whitespace/EOL ("3.5" as a label/k/header value is an error, matching the
+// pure-Python parser's accept/reject behavior).
+inline bool parse_long(Cursor& c, long* out) {
+    skip_spaces(c);
+    if (at_eol(c)) return false;
+    char* q;
+    long v = strtol(c.p, &q, 10);
+    if (q == c.p) return false;
+    if (q < c.end && *q != ' ' && *q != '\t' && *q != '\r' && *q != '\n')
+        return false;
+    c.p = q;
+    *out = v;
+    return true;
+}
+
+// Clinger fast path: a decimal with <= 15 significant digits and a small
+// power-of-ten scale converts exactly with one rounding (mantissa and the
+// power of ten are both exactly representable), i.e. bit-identical to
+// correctly-rounded strtod / Python float(). Covers the generator's %.6f
+// values; anything longer, or with an exponent, falls back to strtod.
+static const double kPow10[23] = {
+    1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11, 1e12,
+    1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+inline bool parse_double(Cursor& c, double* out) {
+    skip_spaces(c);
+    if (at_eol(c)) return false;
+    const char* s = c.p;
+    bool neg = false;
+    if (s < c.end && (*s == '-' || *s == '+')) {
+        neg = (*s == '-');
+        ++s;
+    }
+    uint64_t mant = 0;
+    int digits = 0, frac = 0;
+    const char* d = s;
+    while (d < c.end && *d >= '0' && *d <= '9') {
+        if (digits < 19) mant = mant * 10 + static_cast<uint64_t>(*d - '0');
+        ++digits;
+        ++d;
+    }
+    if (d < c.end && *d == '.') {
+        ++d;
+        while (d < c.end && *d >= '0' && *d <= '9') {
+            if (digits < 19) {
+                mant = mant * 10 + static_cast<uint64_t>(*d - '0');
+                ++frac;
+            }
+            ++digits;
+            ++d;
+        }
+    }
+    bool has_exp = d < c.end && (*d == 'e' || *d == 'E');
+    if (digits > 0 && digits <= 15 && frac <= 22 && !has_exp) {
+        double v = static_cast<double>(mant);
+        if (frac) v /= kPow10[frac];
+        *out = neg ? -v : v;
+        c.p = d;
+        return true;
+    }
+    char* q;
+    double v = strtod_l(c.p, &q, c_locale());
+    if (q == c.p) return false;
+    c.p = q;
+    *out = v;
+    return true;
+}
+
+void set_err(char* errbuf, size_t errlen, const char* msg) {
+    if (errbuf && errlen) {
+        snprintf(errbuf, errlen, "%s", msg);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse the header line "num_data num_queries num_attrs" (common.cpp:12-15).
+// Returns 0 on success.
+int dmlp_parse_header(const char* text, size_t len, long* out3) {
+    Cursor c{text, text + len};
+    for (int i = 0; i < 3; ++i) {
+        if (!parse_long(c, &out3[i])) return 1;
+    }
+    return 0;
+}
+
+// Parse the full body into caller-allocated arrays:
+//   labels      int32[num_data]
+//   data_attrs  float64[num_data * num_attrs]
+//   ks          int32[num_queries]
+//   query_attrs float64[num_queries * num_attrs]
+// Returns 0 on success; nonzero with errbuf set on malformed input.
+int dmlp_parse_body(const char* text, size_t len, long num_data,
+                    long num_queries, long num_attrs, int32_t* labels,
+                    double* data_attrs, int32_t* ks, double* query_attrs,
+                    char* errbuf, size_t errlen) {
+    Cursor c{text, text + len};
+    if (!next_line(c) && num_data + num_queries > 0) {  // skip header
+        set_err(errbuf, errlen, "truncated input");
+        return 1;
+    }
+    for (long i = 0; i < num_data; ++i) {
+        skip_spaces(c);
+        if (at_eol(c)) {
+            set_err(errbuf, errlen, "Line is empty");  // common.cpp:101
+            return 2;
+        }
+        long label;
+        if (!parse_long(c, &label)) {
+            set_err(errbuf, errlen, "Line is wrongly formatted");
+            return 3;
+        }
+        labels[i] = static_cast<int32_t>(label);
+        double* row = data_attrs + i * num_attrs;
+        for (long a = 0; a < num_attrs; ++a) {
+            if (!parse_double(c, &row[a])) {
+                set_err(errbuf, errlen, "Line is wrongly formatted");
+                return 3;
+            }
+        }
+        if (!next_line(c) && i + 1 < num_data + num_queries) {
+            set_err(errbuf, errlen, "truncated input");
+            return 1;
+        }
+    }
+    for (long i = 0; i < num_queries; ++i) {
+        // Query lines must start with 'Q' in column 0 — no leading
+        // whitespace, exactly like the Python parser's line[0] != 'Q'
+        // check (mirroring common.cpp:108-114).
+        if (at_eol(c) || *c.p != 'Q') {
+            set_err(errbuf, errlen, "Line is wrongly formatted");
+            return 4;
+        }
+        ++c.p;
+        long k;
+        if (!parse_long(c, &k)) {
+            set_err(errbuf, errlen, "Line is wrongly formatted");
+            return 4;
+        }
+        ks[i] = static_cast<int32_t>(k);
+        double* row = query_attrs + i * num_attrs;
+        for (long a = 0; a < num_attrs; ++a) {
+            if (!parse_double(c, &row[a])) {
+                set_err(errbuf, errlen, "Line is wrongly formatted");
+                return 4;
+            }
+        }
+        next_line(c);
+    }
+    return 0;
+}
+
+}  // extern "C"

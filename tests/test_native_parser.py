@@ -1,0 +1,115 @@
+"""Native C++ parser: bit-parity with the Python parser + error contract."""
+
+import io
+
+import numpy as np
+import pytest
+
+from dmlp_tpu.io import native
+from dmlp_tpu.io.datagen import generate_input_text
+from dmlp_tpu.io.grammar import parse_input, parse_input_text
+
+pytestmark = pytest.mark.skipif(not native.native_available(),
+                                reason="g++ / native build unavailable")
+
+
+def assert_same_input(a, b):
+    assert a.params == b.params
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.ks, b.ks)
+    # bit-identical doubles: strtod and float() round identically
+    np.testing.assert_array_equal(a.data_attrs, b.data_attrs)
+    np.testing.assert_array_equal(a.query_attrs, b.query_attrs)
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_native_matches_python(seed):
+    text = generate_input_text(300, 40, 7, -1000, 1000, 1, 12, 5, seed=seed)
+    assert_same_input(native.parse_input_text_native(text),
+                      parse_input_text(text))
+
+
+def test_native_negative_and_exponent_values():
+    text = ("2 1 3\n"
+            "0 -1.5 2e-3 300000.125\n"
+            "4 .5 -0.000001 1e5\n"
+            "Q 2 -1 2.5 3\n")
+    assert_same_input(native.parse_input_text_native(text),
+                      parse_input_text(text))
+
+
+def test_native_long_mantissa_strtod_fallback():
+    # > 15 significant digits exits the Clinger fast path; strtod must give
+    # the same correctly-rounded double as Python float().
+    text = ("1 1 2\n"
+            "3 0.1234567890123456789 123456789012345678.9\n"
+            "Q 1 9.87654321987654321e-7 1.7976931348623157e308\n")
+    assert_same_input(native.parse_input_text_native(text),
+                      parse_input_text(text))
+
+
+def test_native_error_contract():
+    # Query line not starting with 'Q' (common.cpp:114)
+    bad = "1 1 2\n0 1.0 2.0\nX 1 1.0 2.0\n"
+    with pytest.raises(ValueError, match="Line is wrongly formatted"):
+        native.parse_input_text_native(bad)
+    with pytest.raises(ValueError, match="Line is wrongly formatted"):
+        parse_input_text(bad)
+    # Empty data line (common.cpp:101)
+    empty = "2 0 2\n0 1.0 2.0\n\n"
+    with pytest.raises(ValueError, match="Line is empty"):
+        native.parse_input_text_native(empty)
+    with pytest.raises(ValueError, match="Line is empty"):
+        parse_input_text(empty)
+
+
+def test_native_rejects_what_python_rejects():
+    # Fractional label: Python's int() raises; native must too (review
+    # finding: accept/reject behavior must not flip at the 1MB threshold).
+    with pytest.raises(ValueError):
+        native.parse_input_text_native("1 0 2\n3.5 1.0 2.0\n")
+    with pytest.raises(ValueError):
+        parse_input_text("1 0 2\n3.5 1.0 2.0\n")
+    # Leading whitespace before 'Q' (Python checks line[0]).
+    with pytest.raises(ValueError, match="Line is wrongly formatted"):
+        native.parse_input_text_native("1 1 1\n0 1.0\n  Q 1 1.0\n")
+    with pytest.raises(ValueError, match="Line is wrongly formatted"):
+        parse_input_text("1 1 1\n0 1.0\n  Q 1 1.0\n")
+
+
+def test_native_accepts_bytes():
+    text = generate_input_text(20, 3, 2, 0, 1, 1, 4, 2)
+    assert_same_input(native.parse_input_text_native(text.encode("ascii")),
+                      parse_input_text(text))
+
+
+def test_corrupt_so_degrades_to_python(monkeypatch, tmp_path):
+    bad = tmp_path / "_bad.so"
+    bad.write_bytes(b"not a shared object")
+    monkeypatch.setattr(native, "_LIB", str(bad))
+    monkeypatch.setattr(native, "_SRC", str(bad))  # mtime check passes
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_lib", None)
+    assert not native.native_available()
+
+
+def test_native_zero_records():
+    text = "0 0 4\n"
+    inp = native.parse_input_text_native(text)
+    assert inp.params.num_data == 0 and inp.params.num_queries == 0
+    assert inp.data_attrs.shape == (0, 4)
+
+
+def test_parse_input_dispatches_to_native_above_threshold(monkeypatch):
+    monkeypatch.setattr("dmlp_tpu.io.grammar._NATIVE_THRESHOLD_BYTES", 1)
+    calls = {}
+    real = native.parse_input_text_native
+
+    def spy(text):
+        calls["native"] = True
+        return real(text)
+    monkeypatch.setattr(native, "parse_input_text_native", spy)
+    text = generate_input_text(50, 5, 3, 0, 1, 1, 4, 2)
+    inp = parse_input(io.StringIO(text))
+    assert calls.get("native")
+    assert inp.params.num_data == 50
