@@ -75,8 +75,11 @@ def time_engine_ms(inp, mode: str, repeats: int) -> float:
     from dmlp_tpu.cli import make_engine
     from dmlp_tpu.config import EngineConfig
 
+    from dmlp_tpu.ops.pallas_distance import native_pallas_backend
+    use_pallas = os.environ.get("BENCH_PALLAS", "1") == "1" \
+        and native_pallas_backend()
     cfg = EngineConfig(mode=mode, exact=False, dtype="float32",
-                       query_block=2048)
+                       query_block=2048, use_pallas=use_pallas)
     engine = make_engine(cfg)
 
     run = engine.run  # same pipeline for every mode -> comparable numbers

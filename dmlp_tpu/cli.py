@@ -60,10 +60,13 @@ def main(argv: Optional[Sequence[str]] = None,
     parser.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16"])
     parser.add_argument("--select", default="auto",
-                        choices=["auto", "sort", "topk"],
+                        choices=["auto", "sort", "topk", "seg"],
                         help="device k-selection strategy")
     parser.add_argument("--phase-times", action="store_true",
                         help="per-phase ms breakdown on stderr (extension)")
+    parser.add_argument("--pallas", action="store_true",
+                        help="fused Pallas distance+segment-min kernel "
+                             "(implies seg selection on large inputs)")
     parser.add_argument("--warmup", action="store_true",
                         help="run the solve once untimed first, so the "
                              "timed region excludes XLA compilation (the "
@@ -80,7 +83,7 @@ def main(argv: Optional[Sequence[str]] = None,
     config = EngineConfig(mode=args.mode, debug=args.debug,
                           exact=not args.fast, data_block=args.data_block,
                           query_block=args.query_block, dtype=args.dtype,
-                          select=args.select)
+                          select=args.select, use_pallas=args.pallas)
 
     timer = EngineTimer()
     with timer.phase("parse"):

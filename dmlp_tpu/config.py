@@ -46,9 +46,15 @@ class EngineConfig:
         tie-order-blind — engines detect candidate lists where a
         distance-tie group hit the boundary and recompute those queries
         exactly on host (engine.finalize.boundary_overflow), so ``run()``
-        parity holds on either path; "auto" = "sort" for small inputs
-        (tie repair there could dominate), "topk" once the padded
-        dataset exceeds AUTO_SELECT_THRESHOLD rows.
+        parity holds on either path; "seg" = segment-min threshold
+        selection (ops.topk.step_seg): reduces each 128-column segment
+        to its min and runs top_k on ~(k+16)*128 gathered candidates
+        instead of the whole tile — exact by distance, with an in-jit
+        fallback to "topk" when segment-min ties make the threshold
+        inconclusive; "auto" = "sort" for small inputs (ties can be
+        adversarial there, cost is negligible), "topk" once the padded
+        dataset exceeds AUTO_SELECT_THRESHOLD rows ("seg" only wins once
+        its reduction is fused into the distance pass — use_pallas).
       debug: human-readable output instead of checksums — the -DDEBUG
         build of the reference (common.cpp:72-78).
       use_pallas: use the fused Pallas distance kernel where available.
@@ -72,7 +78,7 @@ class EngineConfig:
             raise ValueError(f"unknown mode {self.mode!r}")
         if self.dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unsupported dtype {self.dtype!r}")
-        if self.select not in ("auto", "sort", "topk"):
+        if self.select not in ("auto", "sort", "topk", "seg"):
             raise ValueError(f"unknown select {self.select!r}")
         if (self.data_block is not None and self.data_block <= 0) \
                 or self.query_block <= 0:
@@ -84,9 +90,22 @@ class EngineConfig:
         """Concrete selection strategy for a dataset of ``padded_rows``."""
         if self.select != "auto":
             return self.select
-        return "topk" if padded_rows > self.AUTO_SELECT_THRESHOLD else "sort"
+        if padded_rows <= self.AUTO_SELECT_THRESHOLD:
+            return "sort"
+        # Measured on TPU v5e: plain XLA "seg" re-reads the distance tile
+        # for its segment-min pass and lands at ~the same cost as "topk";
+        # the fused Pallas producer makes "seg" the winner.
+        return "seg" if self.use_pallas else "topk"
+
+    def resolve_granule(self, select: str) -> int:
+        """data_block granularity: whole 1024-column Pallas tiles for the
+        fused seg producer, whole 128-column segments for XLA seg, 8 rows
+        otherwise (must stay in sync with ops.pallas_distance.supports)."""
+        if select == "seg":
+            return 1024 if self.use_pallas else 128
+        return 8
 
     def resolve_data_block(self, select: str) -> int:
         if self.data_block is not None:
             return self.data_block
-        return 65536 if select == "topk" else 2048
+        return 65536 if select in ("topk", "seg") else 2048

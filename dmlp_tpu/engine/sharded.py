@@ -75,11 +75,12 @@ class ShardedEngine:
         key = (k, data_block, select)
         if key not in self._fns:
             merge = self._merge_strategy
+            use_pallas = self.config.use_pallas
 
             def local(data_a, data_l, data_i, q_attrs):
                 top = streaming_topk(q_attrs, data_a, data_l, data_i,
                                      k=k, data_block=data_block,
-                                     select=select)
+                                     select=select, use_pallas=use_pallas)
                 if merge == "allgather":
                     return allgather_merge_topk(top, k, DATA_AXIS)
                 return ring_allreduce_topk(top, k, DATA_AXIS)
@@ -104,11 +105,12 @@ class ShardedEngine:
             data_block = min(cfg.data_block, shard_rows_est)
         else:
             data_block = fit_blocks(max(-(-n // r), 1),
-                                    cfg.resolve_data_block(select))
+                                    cfg.resolve_data_block(select),
+                                    granule=cfg.resolve_granule(select))
         d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(inp, data_block)
         kmax = int(inp.ks.max()) if inp.params.num_queries else 1
         extra = cfg.margin if cfg.exact else 0
-        if select == "topk":
+        if select in ("topk", "seg"):
             extra = max(extra, 8)  # detector slack, see single._prep
         shard_rows = d_attrs.shape[0] // r
         k = max(min(round_up(kmax + extra, 8), shard_rows * r), kmax)
@@ -125,7 +127,8 @@ class ShardedEngine:
         dists, labels, ids = self.candidates(inp)
         results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
                                 inp.data_attrs, exact=self.config.exact)
-        if self._last_select == "topk" and dists.shape[1] < inp.params.num_data:
+        if self._last_select in ("topk", "seg") \
+                and dists.shape[1] < inp.params.num_data:
             # Per-shard truncation of a tie group surfaces as the same
             # boundary equality on the merged lists (the tie value fills the
             # tail), so one detector covers both engines. width >= num_data

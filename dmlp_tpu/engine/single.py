@@ -39,18 +39,19 @@ def round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def fit_blocks(n: int, target_block: int) -> int:
-    """A data_block (multiple of 8, <= ~target_block) whose round_up padding
-    wastes < 8 * nblocks rows of n.
+def fit_blocks(n: int, target_block: int, granule: int = 8) -> int:
+    """A data_block (multiple of ``granule``, <= ~target_block) whose
+    round_up padding wastes < granule * nblocks rows of n.
 
     Plain round_up(n, target_block) can waste up to target_block - 1 rows
     (31% at n=200k, target=64k) — real compute, since padded rows still ride
     the matmul. Shrinking the block to ~n/nblocks keeps the scan length and
-    the waste both minimal.
+    the waste both minimal. The "seg" selection needs granule=128 (whole
+    lane-width segments).
     """
     n = max(n, 1)
-    nblocks = max(1, -(-n // max(target_block, 8)))
-    return round_up(-(-n // nblocks), 8)
+    nblocks = max(1, -(-n // max(target_block, granule)))
+    return round_up(-(-n // nblocks), granule)
 
 
 def pad_dataset(inp: KNNInput, multiple: int, dtype: np.dtype
@@ -78,27 +79,31 @@ def pad_dataset(inp: KNNInput, multiple: int, dtype: np.dtype
     return attrs, labels, ids
 
 
-@functools.partial(jax.jit, static_argnames=("k", "data_block", "select"))
+@functools.partial(jax.jit,
+                   static_argnames=("k", "data_block", "select", "use_pallas"))
 def _topk_blocks(data_attrs, data_labels, data_ids, q_blocks, *, k,
-                 data_block, select):
+                 data_block, select, use_pallas=False):
     """All query blocks in one dispatch: ``lax.map`` keeps the live distance
     tile at (query_block x data_block) while avoiding per-block Python
     dispatch + per-block device->host readbacks (which dominate over a
     tunneled PJRT link)."""
     return jax.lax.map(
         lambda q: streaming_topk(q, data_attrs, data_labels, data_ids,
-                                 k=k, data_block=data_block, select=select),
+                                 k=k, data_block=data_block, select=select,
+                                 use_pallas=use_pallas),
         q_blocks)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("k", "data_block", "num_labels", "select"))
+                   static_argnames=("k", "data_block", "num_labels", "select",
+                                    "use_pallas"))
 def _full_blocks(data_attrs, data_labels, data_ids, q_blocks, ks_blocks, *,
-                 k, data_block, num_labels, select):
+                 k, data_block, num_labels, select, use_pallas=False):
     def one(args):
         q_attrs, ks = args
         top = streaming_topk(q_attrs, data_attrs, data_labels, data_ids,
-                             k=k, data_block=data_block, select=select)
+                             k=k, data_block=data_block, select=select,
+                             use_pallas=use_pallas)
         rd, rids, in_k = report_order(top, ks)
         valid = in_k & (top.ids >= 0)
         predicted = majority_vote(top.labels, valid, num_labels)
@@ -120,11 +125,12 @@ class SingleChipEngine:
         if cfg.data_block is not None:
             data_block = min(cfg.data_block, round_up(max(n, 1), 8))
         else:
-            data_block = fit_blocks(n, cfg.resolve_data_block(select))
+            data_block = fit_blocks(n, cfg.resolve_data_block(select),
+                                    granule=cfg.resolve_granule(select))
         attrs, labels, ids = pad_dataset(inp, data_block, np.float32)
         kmax = int(inp.ks.max()) if inp.params.num_queries else 1
         extra = cfg.margin if cfg.exact else 0
-        if select == "topk":
+        if select in ("topk", "seg"):
             # The tie-overflow detector needs ks < kcap slack: with zero
             # extra slots the k-th and last candidate coincide and every
             # query would be flagged (degenerate all-repair).
@@ -149,7 +155,8 @@ class SingleChipEngine:
             q_attrs.reshape(qpad // qb, qb, -1), self._dtype)
 
         out: TopK = _topk_blocks(d_attrs, d_labels, d_ids, q_blocks,
-                                 k=k, data_block=data_block, select=select)
+                                 k=k, data_block=data_block, select=select,
+                                 use_pallas=cfg.use_pallas)
         dists = np.asarray(out.dists, np.float64).reshape(qpad, -1)[:nq]
         labels = np.asarray(out.labels).reshape(qpad, -1)[:nq]
         ids = np.asarray(out.ids).reshape(qpad, -1)[:nq]
@@ -165,7 +172,8 @@ class SingleChipEngine:
         dists, labels, ids = self.candidates(inp)
         results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
                                 inp.data_attrs, exact=self.config.exact)
-        if self._last_select == "topk" and dists.shape[1] < inp.params.num_data:
+        if self._last_select in ("topk", "seg") \
+                and dists.shape[1] < inp.params.num_data:
             # (width >= num_data means every real point is a candidate —
             # nothing can have been truncated.)
             suspects = np.nonzero(boundary_overflow(dists, inp.ks))[0]
@@ -192,7 +200,7 @@ class SingleChipEngine:
             jnp.asarray(q_attrs.reshape(nb, qb, -1), self._dtype),
             jnp.asarray(ks_pad.reshape(nb, qb)),
             k=k, data_block=data_block, num_labels=num_labels,
-            select=select)
+            select=select, use_pallas=cfg.use_pallas)
         preds = np.asarray(p).reshape(qpad)[:nq]
         rids = np.asarray(i).reshape(qpad, -1)[:nq]
         rd = np.asarray(d, np.float64).reshape(qpad, -1)[:nq]

@@ -1,0 +1,124 @@
+"""Pallas TPU kernel: fused masked distance tile + per-segment minima.
+
+The "seg" selection (ops.topk.step_seg) needs two views of each distance
+tile: the tile itself (to gather candidate columns from) and the minimum of
+every 128-column segment (to pick the candidate segments). Computed with
+stock XLA ops the segment-min pass re-reads the whole tile from HBM —
+measured on TPU v5e that second pass costs more than the matmul that
+produced the tile. This kernel produces both outputs in one pass: the MXU
+computes the cross-term block, the VPU applies the norm expansion
+``|q-d|^2 = |q|^2 + |d|^2 - 2 q.d`` + sentinel masking and reduces the
+segment minima while the block is still in VMEM.
+
+Grid: (Qb/TQ, B/TN) tiles; every tile is read/written exactly once.
+Requires TN % 128 == 0 (whole lane-width segments). On non-TPU backends the
+kernel runs in interpreter mode, so CPU tests exercise the identical code.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+SEG = 128  # candidate-segment width = one TPU lane row
+
+_TQ = 1024  # query rows per tile (also the segmin lane dim -> 128-multiple)
+_TN = 1024  # data columns per tile (8 segments -> valid sublane count)
+
+
+def _tile(n: int, target: int, granule: int) -> int:
+    """Largest granule-multiple divisor of n that is <= target (n itself if
+    none exists — n is then a single tile, valid as a full-dimension block)."""
+    t = min(target, n)
+    t -= t % granule
+    while t >= granule:
+        if n % t == 0:
+            return t
+        t -= granule
+    return n
+
+
+def supports(qb: int, b: int, a: int) -> bool:
+    """Shapes the kernel can tile within Mosaic's constraints + VMEM.
+
+    The transposed segmin output needs tn/SEG sublanes divisible by 8
+    (tn % 1024 == 0) unless one tile spans all of b; query tiles must be a
+    multiple of 8 (engines pad to 8) and either divide into 128-multiples
+    or fit a single full-dim tile small enough for VMEM. The VMEM budget
+    covers the double-buffered dist, q, and d blocks (q/d scale with the
+    attribute count, so wide-attribute inputs are gated out too).
+    """
+    if b % SEG != 0 or qb % 8 != 0:
+        return False
+    tn = _tile(b, _TN, 8 * SEG)
+    tq = _tile(qb, _TQ, SEG)
+    blocks_bytes = (tq * tn + tq * a + tn * a) * 4
+    return 2 * blocks_bytes <= 12 * 2**20  # double-buffered
+
+
+def _kernel(q_ref, d_ref, qn_ref, dn_ref, ids_ref, dist_ref, segmin_ref):
+    cross = jax.lax.dot_general(
+        q_ref[:], d_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dist = qn_ref[:] + dn_ref[:] - 2.0 * cross
+    dist = jnp.maximum(dist, 0.0)
+    dist = jnp.where(ids_ref[:] < 0, jnp.inf, dist)
+    dist_ref[:] = dist
+    tq, tn = dist.shape
+    # Segment minima are emitted transposed, (segments, queries): the
+    # (tn/SEG, tq) block satisfies Mosaic's (8, 128) tiling where the
+    # natural (tq, tn/SEG) layout's tiny lane dimension would not.
+    segmin_ref[:] = dist.reshape(tq, tn // SEG, SEG).min(axis=-1).T
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_dist_segmin(q_attrs: jax.Array, d_attrs: jax.Array,
+                      data_ids: jax.Array, interpret: bool = False):
+    """(queries (Qb, A), data (B, A), ids (B,)) -> (dist (Qb, B) f32,
+    segmin (Qb, B/SEG) f32). Sentinel columns (id < 0) give +inf.
+
+    Qb must divide by 8 and B by SEG; A is unconstrained (one MXU pass).
+    """
+    qb, a = q_attrs.shape
+    b = d_attrs.shape[0]
+    assert supports(qb, b, a), f"untileable shape (qb={qb}, b={b}, a={a});" \
+        " gate on supports() first"
+    tq = _tile(qb, _TQ, SEG)
+    tn = _tile(b, _TN, 8 * SEG)
+
+    q32 = q_attrs.astype(jnp.float32)
+    d32 = d_attrs.astype(jnp.float32)
+    qn = jnp.sum(q32 * q32, axis=-1, keepdims=True)          # (Qb, 1)
+    dn = jnp.sum(d32 * d32, axis=-1)[None, :]                # (1, B)
+    ids2 = data_ids[None, :]                                 # (1, B)
+
+    grid = (qb // tq, b // tn)
+    dist, segmin_t = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tq, a), lambda i, j: (i, 0)),
+            pl.BlockSpec((tn, a), lambda i, j: (j, 0)),
+            pl.BlockSpec((tq, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, tn), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tq, tn), lambda i, j: (i, j)),
+            pl.BlockSpec((tn // SEG, tq), lambda i, j: (j, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((qb, b), jnp.float32),
+            jax.ShapeDtypeStruct((b // SEG, qb), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q32, d32, qn, dn, ids2)
+    return dist, segmin_t.T
+
+
+def native_pallas_backend() -> bool:
+    """True when Pallas compiles natively here (else use interpret mode)."""
+    return jax.default_backend() == "tpu"

@@ -74,7 +74,7 @@ def merge_topk(a: TopK, b: TopK, k: int) -> TopK:
 def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
                    data_labels: jax.Array, data_ids: jax.Array, k: int,
                    data_block: int, accum_dtype=jnp.float32,
-                   select: str = "sort") -> TopK:
+                   select: str = "sort", use_pallas: bool = False) -> TopK:
     """Top-k nearest data points per query, streaming over data blocks.
 
     Computes (Qb x data_block) distance tiles one block at a time and folds
@@ -120,6 +120,85 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
                     jnp.broadcast_to(bids[None, :], tile.shape))
         return merge_topk(carry, cand, k), None
 
+    def step_seg(carry: TopK, blk):
+        """Segment-min threshold selection (select="seg").
+
+        Exact tile top-k with ~B/128 of the sort work: reduce the tile to
+        per-128-column segment minima, pick the S = k+16 smallest-min
+        segments (every true tile-top-k point lives in a segment whose min
+        is <= the k-th smallest segment min T — if one didn't, >= k segments
+        with min < its distance would each contribute a closer point), and
+        run the real top_k on just the gathered S*128 candidates. When the
+        S-th selected min still ties T (more eligible segments may exist
+        beyond S — duplicate-heavy data), a lax.cond falls back to the full
+        top_k for that step, so the result is always the exact per-tile
+        top-k by distance.
+        """
+        battrs, blabels, bids = blk
+        from dmlp_tpu.ops.pallas_distance import (fused_dist_segmin,
+                                                  native_pallas_backend,
+                                                  supports)
+        if use_pallas and supports(query_attrs.shape[0], battrs.shape[0],
+                                   battrs.shape[1]):
+            tile, segmin = fused_dist_segmin(
+                query_attrs, battrs, bids,
+                interpret=not native_pallas_backend())
+        else:
+            tile = masked_pairwise_sq_l2(query_attrs, battrs, bids,
+                                         accum_dtype)
+            segmin = None
+        qb_, bcols = tile.shape
+        nseg = bcols // 128
+        s = min(nseg, k + 16)
+
+        if segmin is None:
+            segmin = tile.reshape(qb_, nseg, 128).min(axis=-1)
+        neg_sel, seg_idx = jax.lax.top_k(-segmin, s)      # (Qb, S)
+        sel_min = -neg_sel                                 # asc by segment min
+        t = sel_min[:, min(k, s) - 1]
+        hazard = (s < nseg) & jnp.any(
+            jnp.isfinite(sel_min[:, -1]) & (sel_min[:, -1] <= t))
+
+        def merge_cand(carry_, cand_d, cand_l, cand_i):
+            """top_k over carry + candidate columns -> (Qb, k) TopK."""
+            alld = jnp.concatenate([carry_.dists, cand_d], axis=-1)
+            negd, idx = jax.lax.top_k(-alld, k)
+            from_carry = idx < k
+            cidx = jnp.minimum(idx, k - 1)
+            bidx = jnp.maximum(idx - k, 0)
+            labels_ = jnp.where(
+                from_carry, jnp.take_along_axis(carry_.labels, cidx, axis=-1),
+                jnp.take_along_axis(cand_l, bidx, axis=-1))
+            ids_ = jnp.where(
+                from_carry, jnp.take_along_axis(carry_.ids, cidx, axis=-1),
+                jnp.take_along_axis(cand_i, bidx, axis=-1))
+            return TopK(-negd, labels_, ids_)
+
+        def full(args):
+            carry_, tile_, blabels_, bids_, _ = args
+            return merge_cand(carry_, tile_,
+                              jnp.broadcast_to(blabels_[None, :], tile_.shape),
+                              jnp.broadcast_to(bids_[None, :], tile_.shape))
+
+        def seg(args):
+            carry_, tile_, blabels_, bids_, seg_idx_ = args
+            # Gather whole 128-lane segments along the segment axis —
+            # contiguous lanes, ~4x faster on TPU than a flat-index gather.
+            t3 = tile_.reshape(qb_, nseg, 128)
+            cand_d = jnp.take_along_axis(
+                t3, seg_idx_[:, :, None], axis=1).reshape(qb_, s * 128)
+            cand_l = blabels_.reshape(nseg, 128)[seg_idx_].reshape(
+                qb_, s * 128)
+            cand_i = bids_.reshape(nseg, 128)[seg_idx_].reshape(qb_, s * 128)
+            return merge_cand(carry_, cand_d, cand_l, cand_i)
+
+        if s == nseg:
+            out = full((carry, tile, blabels, bids, seg_idx))
+        else:
+            out = jax.lax.cond(hazard, full, seg,
+                               (carry, tile, blabels, bids, seg_idx))
+        return out, None
+
     def step_topk(carry: TopK, blk):
         battrs, blabels, bids = blk
         tile = masked_pairwise_sq_l2(query_attrs, battrs, bids, accum_dtype)
@@ -138,8 +217,10 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
             bids[bidx])
         return TopK(-negd, new_labels, new_ids), None
 
-    if select not in ("sort", "topk"):
+    if select not in ("sort", "topk", "seg"):
         raise ValueError(f"unknown select {select!r}")
-    step = step_sort if select == "sort" else step_topk
+    if select == "seg" and (data_block % 128 != 0 or data_block < 256):
+        select = "topk"  # seg needs whole 128-lane segments to pay off
+    step = {"sort": step_sort, "topk": step_topk, "seg": step_seg}[select]
     out, _ = jax.lax.scan(step, init, blocks)
     return out

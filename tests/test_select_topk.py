@@ -77,6 +77,57 @@ def test_single_topk_matches_golden_continuous():
     assert_same_results(eng.run(inp), knn_golden(inp))
 
 
+def test_single_seg_gather_path_matches_golden():
+    # The gather/cond path only traces when nseg > k + 16: kmax=4 with
+    # margin 0 (slack bumps it to 8) gives selection width k=16, S=32;
+    # data_block=8192 -> nseg=64 > 32. (Smaller tiles hit the static
+    # s == nseg full branch and never compile the gather — review finding.)
+    text = generate_input_text(9000, 60, 6, -5, 5, 1, 4, 4, seed=41)
+    inp = parse_input_text(text)
+    eng = SingleChipEngine(EngineConfig(select="seg", data_block=8192,
+                                        query_block=16, margin=0))
+    assert eng._prep(inp)[3] + 16 < 8192 // 128  # gather path is live
+    assert_same_results(eng.run(inp), knn_golden(inp))
+
+
+def test_single_seg_hazard_fallback_tie_heavy():
+    # Integer grid in 2D: massive duplicate distances tie the segment
+    # minima at the threshold, so the in-jit hazard cond must route to the
+    # full top_k branch and preserve exactness. nseg=72 > S so the cond is
+    # actually compiled (not the static full branch).
+    rng = np.random.default_rng(8)
+    n = 9216
+    data = rng.integers(0, 4, size=(n, 2)).astype(np.float64)
+    queries = rng.integers(0, 4, size=(24, 2)).astype(np.float64)
+    labels = rng.integers(0, 5, size=n).astype(np.int32)
+    ks = rng.integers(1, 8, size=24).astype(np.int32)
+    inp = KNNInput(Params(n, 24, 2), labels, data, ks, queries)
+    eng = SingleChipEngine(EngineConfig(select="seg", data_block=9216,
+                                        query_block=8, margin=0))
+    assert eng._prep(inp)[3] + 16 < 9216 // 128
+    assert_same_results(eng.run(inp), knn_golden(inp))
+
+
+def test_seg_small_block_falls_back_to_topk():
+    # data_block not a multiple of 128 -> streaming_topk silently uses the
+    # topk step; results still golden.
+    text = generate_input_text(600, 30, 4, 0, 9, 1, 8, 3, seed=17)
+    inp = parse_input_text(text)
+    eng = SingleChipEngine(EngineConfig(select="seg", data_block=72,
+                                        query_block=8))
+    assert_same_results(eng.run(inp), knn_golden(inp))
+
+
+@pytest.mark.parametrize("cls,mode", [(ShardedEngine, "sharded"),
+                                      (RingEngine, "ring")])
+def test_mesh_seg_matches_golden(cls, mode):
+    text = generate_input_text(4096, 48, 5, 0, 10, 1, 16, 6, seed=23)
+    inp = parse_input_text(text)
+    eng = cls(EngineConfig(mode=mode, select="seg", data_block=256,
+                           query_block=8), mesh=make_mesh())
+    assert_same_results(eng.run(inp), knn_golden(inp))
+
+
 @pytest.mark.parametrize("cls,mode", [(ShardedEngine, "sharded"),
                                       (RingEngine, "ring")])
 def test_mesh_topk_tie_overflow_repair(cls, mode):
