@@ -67,6 +67,9 @@ def main(argv: Optional[Sequence[str]] = None,
     parser.add_argument("--pallas", action="store_true",
                         help="fused Pallas distance+segment-min kernel "
                              "(implies seg selection on large inputs)")
+    parser.add_argument("--profile", metavar="DIR", default=None,
+                        help="write a jax.profiler trace of the solve to "
+                             "DIR (survey §5.1 observability gap)")
     parser.add_argument("--warmup", action="store_true",
                         help="run the solve once untimed first, so the "
                              "timed region excludes XLA compilation (the "
@@ -101,8 +104,14 @@ def main(argv: Optional[Sequence[str]] = None,
         if args.warmup:
             with timer.phase("warmup_compile"):
                 solve(inp)
+        import contextlib
+        profile_cm = contextlib.nullcontext()
+        if args.profile:
+            import jax
+            profile_cm = jax.profiler.trace(args.profile)
         timer.start()
-        results = solve(inp)
+        with profile_cm:
+            results = solve(inp)
     text = format_results(results, debug=config.debug)
     timer.stop()
 

@@ -123,6 +123,36 @@ class ShardedEngine:
                 np.asarray(top.labels)[:nq],
                 np.asarray(top.ids)[:nq])
 
+    def solve_global(self, d_attrs, d_labels, d_ids, q_attrs, kmax: int):
+        """Run the compiled sharded program on pre-placed global arrays.
+
+        The multi-host feed path (parallel.distributed): each process
+        contributes its local shard via make_global_dataset/queries; this
+        method consumes the resulting jax.Arrays directly — no per-host
+        full-dataset ingest. Shapes must already be mesh-uniform (data rows
+        divisible by the data-axis size, query rows by the query-axis
+        size). Returns the merged TopK (global, query-sharded).
+        """
+        from dmlp_tpu.ops.pallas_distance import _tile
+
+        cfg = self.config
+        r = self.mesh.devices.shape[0]
+        shard_rows = d_attrs.shape[0] // r
+        select = cfg.resolve_select(shard_rows)
+        granule = cfg.resolve_granule(select)
+        if cfg.data_block is not None:
+            data_block = min(cfg.data_block, shard_rows)
+        else:
+            data_block = _tile(shard_rows, cfg.resolve_data_block(select),
+                               min(granule, shard_rows))
+        extra = cfg.margin if cfg.exact else 0
+        if select in ("topk", "seg"):
+            extra = max(extra, 8)
+        k = max(min(round_up(kmax + extra, 8), shard_rows * r), kmax)
+        self._last_select = select
+        return self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
+                                               q_attrs)
+
     def run(self, inp: KNNInput) -> List[QueryResult]:
         dists, labels, ids = self.candidates(inp)
         results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
