@@ -121,7 +121,7 @@ def repair_boundary_overflow(results: List[QueryResult],
                                   fixed.neighbor_dists)
 
 
-def finalize_host(cand_dists: np.ndarray, cand_labels: np.ndarray,
+def finalize_host(cand_dists: np.ndarray | None, cand_labels: np.ndarray,
                   cand_ids: np.ndarray, ks: np.ndarray,
                   query_attrs: np.ndarray, data_attrs: np.ndarray,
                   exact: bool = True,
@@ -130,6 +130,9 @@ def finalize_host(cand_dists: np.ndarray, cand_labels: np.ndarray,
 
     Args:
       cand_dists/labels/ids: (Q, K) device candidate lists (selection order).
+        ``cand_dists`` may be None when ``exact`` (distances are rescored
+        from the float64 originals anyway — engines then skip fetching the
+        device distance matrix entirely).
       ks: (Q,) per-query k (K >= ks.max() required).
       query_attrs/data_attrs: float64 originals, used only when ``exact``.
       exact: rescore candidates in float64 and re-select (parity mode).

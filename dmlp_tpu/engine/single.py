@@ -27,12 +27,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from dmlp_tpu.config import EngineConfig
-from dmlp_tpu.engine.finalize import (boundary_overflow, finalize_host,
-                                      repair_boundary_overflow)
+from dmlp_tpu.engine.finalize import finalize_host, repair_boundary_overflow
 from dmlp_tpu.io.grammar import KNNInput
 from dmlp_tpu.io.report import QueryResult
-from dmlp_tpu.ops.topk import TopK, streaming_topk
+from dmlp_tpu.ops.topk import TopK, init_topk, make_block_step, streaming_topk
 from dmlp_tpu.ops.vote import majority_vote, report_order
+
+# Per-chunk distance-tile budget for the pipelined driver (bytes). The live
+# tile is (query_rows x chunk_rows) f32; chunk/query blocking keeps it under
+# this so HBM never holds a Q x N matrix.
+_TILE_BUDGET = 1 << 30
 
 
 def round_up(x: int, m: int) -> int:
@@ -52,6 +56,17 @@ def fit_blocks(n: int, target_block: int, granule: int = 8) -> int:
     n = max(n, 1)
     nblocks = max(1, -(-n // max(target_block, granule)))
     return round_up(-(-n // nblocks), granule)
+
+
+def resolve_kcap(cfg: EngineConfig, kmax: int, select: str, cap: int) -> int:
+    """Device candidate-list width: kmax + margin, rounded to 8, clamped to
+    [kmax, cap]. The fast selection paths get >= 8 slack beyond kmax even
+    with margin 0: the tie-overflow detector compares the k-th and last
+    candidate, which coincide without slack (degenerate all-repair)."""
+    extra = cfg.margin if cfg.exact else 0
+    if select in ("topk", "seg"):
+        extra = max(extra, 8)
+    return max(min(round_up(kmax + extra, 8), cap), kmax)
 
 
 def pad_dataset(inp: KNNInput, multiple: int, dtype: np.dtype
@@ -77,6 +92,33 @@ def pad_dataset(inp: KNNInput, multiple: int, dtype: np.dtype
     ids = np.full(npad, -1, np.int32)
     ids[:n] = np.arange(n, dtype=np.int32)
     return attrs, labels, ids
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select", "use_pallas"))
+def _chunk_fold(carry: TopK, q_attrs, battrs, blabels, bids, *, k, select,
+                use_pallas=False) -> TopK:
+    """Fold one data chunk into the running top-k (pipelined driver step).
+
+    One dispatch per chunk: the host enqueues chunk transfers and fold
+    dispatches back-to-back, so the device DMAs chunk i+1 while computing
+    chunk i — the async replacement for the reference's scatter-then-compute
+    phasing (engine.cpp:62-131, :233-257), which matters here because the
+    host->device link (not the MXU) bounds the solve.
+    """
+    step = make_block_step(select, k, use_pallas, carry.dists.dtype)
+    return step(carry, q_attrs, battrs, blabels, bids)
+
+
+@jax.jit
+def _device_flags(dists, ks):
+    """Per-query tie-overflow hazard flags, computed on device so the exact
+    path never reads the (Q, K) distance matrix back over the link (see
+    engine.finalize.boundary_overflow for the hazard derivation)."""
+    kcap = dists.shape[1]
+    last = dists[:, kcap - 1]
+    kth = jnp.take_along_axis(
+        dists, jnp.clip(ks[:, None] - 1, 0, kcap - 1), axis=1)[:, 0]
+    return jnp.isfinite(last) & (last == kth)
 
 
 @functools.partial(jax.jit,
@@ -117,6 +159,7 @@ class SingleChipEngine:
     def __init__(self, config: EngineConfig = EngineConfig()):
         self.config = config
         self._dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+        self.last_phase_ms: dict = {}
 
     def _prep(self, inp: KNNInput):
         cfg = self.config
@@ -129,21 +172,14 @@ class SingleChipEngine:
                                     granule=cfg.resolve_granule(select))
         attrs, labels, ids = pad_dataset(inp, data_block, np.float32)
         kmax = int(inp.ks.max()) if inp.params.num_queries else 1
-        extra = cfg.margin if cfg.exact else 0
-        if select in ("topk", "seg"):
-            # The tie-overflow detector needs ks < kcap slack: with zero
-            # extra slots the k-th and last candidate coincide and every
-            # query would be flagged (degenerate all-repair).
-            extra = max(extra, 8)
-        k = min(round_up(kmax + extra, 8), attrs.shape[0])
-        k = max(k, kmax)  # never below the widest query's k
+        k = resolve_kcap(cfg, kmax, select, attrs.shape[0])
         d_attrs = jnp.asarray(attrs, self._dtype)
         self._last_select = select  # run() gates the tie-overflow repair on it
         return (d_attrs, jnp.asarray(labels), jnp.asarray(ids), k, data_block,
                 select)
 
-    def candidates(self, inp: KNNInput) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Device pass: (Q, K) selection-ordered candidate lists as NumPy."""
+    def _solve_scan(self, inp: KNNInput) -> Tuple[TopK, int]:
+        """Whole-dataset staging + one lax.map/scan dispatch ("sort" path)."""
         cfg = self.config
         d_attrs, d_labels, d_ids, k, data_block, select = self._prep(inp)
         nq = inp.params.num_queries
@@ -157,28 +193,146 @@ class SingleChipEngine:
         out: TopK = _topk_blocks(d_attrs, d_labels, d_ids, q_blocks,
                                  k=k, data_block=data_block, select=select,
                                  use_pallas=cfg.use_pallas)
-        dists = np.asarray(out.dists, np.float64).reshape(qpad, -1)[:nq]
-        labels = np.asarray(out.labels).reshape(qpad, -1)[:nq]
-        ids = np.asarray(out.ids).reshape(qpad, -1)[:nq]
+        return TopK(out.dists.reshape(qpad, -1), out.labels.reshape(qpad, -1),
+                    out.ids.reshape(qpad, -1)), qpad
+
+    def _solve_pipelined(self, inp: KNNInput) -> Tuple[TopK, int]:
+        """Chunked staging + one fold dispatch per chunk ("topk"/"seg").
+
+        The dataset is staged in ~chunk_rows-row pieces, each followed by
+        its fold dispatch; transfers and compute are enqueued back-to-back
+        so the device DMAs chunk i+1 while folding chunk i. On a
+        bandwidth-limited host link (tunneled PJRT, or a pod feeding over
+        DCN) the solve then costs ~max(transfer, compute), not their sum.
+        """
+        import time as _time
+
+        cfg = self.config
+        n = inp.params.num_data
+        na = inp.params.num_attrs
+        nq = inp.params.num_queries
+        select = cfg.resolve_select(round_up(max(n, 1), 8))
+        self._last_select = select
+        granule = cfg.resolve_granule(select)
+
+        t0 = _time.perf_counter()
+        npad = round_up(max(n, 1), granule)
+        # ~50k-row chunks measured best on the tunneled v5e link: big enough
+        # that per-chunk merge work stays negligible, small enough that the
+        # first fold starts while later chunks are still in flight.
+        target = cfg.data_block or 51200
+        nchunks = max(1, -(-npad // round_up(target, granule)))
+        chunk_rows = round_up(-(-npad // nchunks), granule)
+
+        # Query padding: multiples of 1024 keep the fused Pallas tiling
+        # eligible (ops.pallas_distance.supports); 8 otherwise.
+        qgran = 1024 if (cfg.use_pallas and select == "seg"
+                         and nq > 1024) else 8
+        qpad = round_up(max(nq, 1), qgran)
+        # Bound the live (query_rows x chunk_rows) f32 tile by both the
+        # configured query_block and the HBM tile budget.
+        qsb = min(qpad, round_up(cfg.query_block, qgran))
+        while qsb > qgran and qsb * chunk_rows * 4 > _TILE_BUDGET:
+            qsb -= qgran
+        nqb = -(-qpad // qsb)
+        qpad = nqb * qsb
+
+        kmax = int(inp.ks.max()) if nq else 1
+        k = resolve_kcap(cfg, kmax, select, nchunks * chunk_rows)
+
+        q_attrs = np.zeros((qpad, na), np.float32)
+        q_attrs[:nq] = inp.query_attrs
+        q_dev = [jnp.asarray(q_attrs[i * qsb:(i + 1) * qsb], self._dtype)
+                 for i in range(nqb)]
+
+        # Stage chunks (async puts) and enqueue their folds immediately.
+        carries = [init_topk(qsb, k) for _ in range(nqb)]
+        src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
+        for c in range(nchunks):
+            lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
+            a = np.zeros((chunk_rows, na), np.float32)
+            lab = np.full(chunk_rows, -1, np.int32)
+            ids = np.full(chunk_rows, -1, np.int32)
+            if hi > lo:
+                a[:hi - lo] = src_attrs[lo:hi]
+                lab[:hi - lo] = inp.labels[lo:hi]
+                ids[:hi - lo] = np.arange(lo, hi, dtype=np.int32)
+            da = jnp.asarray(a, self._dtype)
+            dl, di = jnp.asarray(lab), jnp.asarray(ids)
+            for b in range(nqb):
+                carries[b] = _chunk_fold(carries[b], q_dev[b], da, dl, di,
+                                         k=k, select=select,
+                                         use_pallas=cfg.use_pallas)
+        self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
+
+        if nqb == 1:
+            return carries[0], qpad
+        return TopK(*(jnp.concatenate(parts) for parts in
+                      zip(*carries))), qpad
+
+    def _solve(self, inp: KNNInput) -> Tuple[TopK, int]:
+        select = self.config.resolve_select(
+            round_up(max(inp.params.num_data, 1), 8))
+        if select == "sort":
+            return self._solve_scan(inp)
+        return self._solve_pipelined(inp)
+
+    def candidates(self, inp: KNNInput) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Device pass: (Q, K) selection-ordered candidate lists as NumPy."""
+        out, qpad = self._solve(inp)
+        nq = inp.params.num_queries
+        dists = np.asarray(out.dists, np.float64)[:nq]
+        labels = np.asarray(out.labels)[:nq]
+        ids = np.asarray(out.ids)[:nq]
         return dists, labels, ids
 
     def run(self, inp: KNNInput) -> List[QueryResult]:
         """Full parity pipeline: device candidates + host float64 finalize.
 
-        On the fast "topk" selection path, queries whose candidate set may
-        have truncated a distance-tie group (boundary_overflow) are
+        On the fast "topk"/"seg" selection paths, queries whose candidate
+        set may have truncated a distance-tie group (boundary_overflow) are
         recomputed exactly — parity holds on either path.
+
+        Readback is kept minimal: in exact mode only the candidate ids and
+        the device-computed hazard flags cross the link (labels are
+        re-derived from ids on host, distances are rescored in float64
+        anyway); the (Q, K) f32 distance matrix is fetched only in fast
+        mode, where it is the result.
         """
-        dists, labels, ids = self.candidates(inp)
+        import time as _time
+
+        nq = inp.params.num_queries
+        n = inp.params.num_data
+        top, qpad = self._solve(inp)
+        kcap = top.dists.shape[1]
+
+        flags_dev = None
+        if self._last_select in ("topk", "seg") and kcap < n:
+            ks_pad = np.ones(qpad, np.int32)
+            ks_pad[:nq] = inp.ks
+            flags_dev = _device_flags(top.dists, jnp.asarray(ks_pad))
+
+        t0 = _time.perf_counter()
+        fetch = ([] if self.config.exact else [top.dists]) + [top.ids] \
+            + ([flags_dev] if flags_dev is not None else [])
+        fetched = list(jax.device_get(fetch))
+        dists = None if self.config.exact \
+            else np.asarray(fetched.pop(0), np.float64)[:nq]
+        ids = fetched.pop(0)[:nq]
+        flags = fetched.pop(0)[:nq] if flags_dev is not None else None
+        labels = np.where(ids >= 0,
+                          inp.labels[np.clip(ids, 0, max(n - 1, 0))], -1) \
+            if n else np.full_like(ids, -1)
+        self.last_phase_ms["fetch"] = (_time.perf_counter() - t0) * 1e3
+
+        t0 = _time.perf_counter()
         results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
                                 inp.data_attrs, exact=self.config.exact)
-        if self._last_select in ("topk", "seg") \
-                and dists.shape[1] < inp.params.num_data:
-            # (width >= num_data means every real point is a candidate —
-            # nothing can have been truncated.)
-            suspects = np.nonzero(boundary_overflow(dists, inp.ks))[0]
+        if flags is not None:
+            suspects = np.nonzero(flags)[0]
             if suspects.size:
                 repair_boundary_overflow(results, suspects, inp)
+        self.last_phase_ms["finalize"] = (_time.perf_counter() - t0) * 1e3
         return results
 
     def run_device_full(self, inp: KNNInput) -> List[QueryResult]:

@@ -119,6 +119,22 @@ def fused_dist_segmin(q_attrs: jax.Array, d_attrs: jax.Array,
     return dist, segmin_t.T
 
 
+@functools.lru_cache(maxsize=1)
 def native_pallas_backend() -> bool:
-    """True when Pallas compiles natively here (else use interpret mode)."""
-    return jax.default_backend() == "tpu"
+    """True when Pallas compiles natively here (else use interpret mode).
+
+    Decided by actually compiling + running a trivial kernel once (cached),
+    not by matching the platform name: tunneled/experimental PJRT platforms
+    (e.g. the 'axon' TPU tunnel) report surprising names, and a name check
+    silently disabled the fused path for a whole benchmark round.
+    """
+    try:
+        def probe(x_ref, o_ref):
+            o_ref[:] = x_ref[:] + 1.0
+
+        x = jnp.zeros((8, 128), jnp.float32)
+        out = pl.pallas_call(
+            probe, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))(x)
+        return bool(jax.device_get(out)[0, 0] == 1.0)
+    except Exception:
+        return False

@@ -96,8 +96,6 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
     (dmlp_tpu.engine.finalize.boundary_overflow) and recompute affected
     queries exactly, so either path yields golden parity.
     """
-    from dmlp_tpu.ops.distance import masked_pairwise_sq_l2
-
     n = data_attrs.shape[0]
     assert n % data_block == 0, "pad data to a multiple of data_block first"
     nblocks = n // data_block
@@ -107,20 +105,60 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
               data_labels.reshape(nblocks, data_block),
               data_ids.reshape(nblocks, data_block))
 
-    init = TopK(
+    init = init_topk(qb, k, accum_dtype)
+    if select == "seg" and (data_block % 128 != 0 or data_block < 256):
+        select = "topk"  # seg needs whole 128-lane segments to pay off
+    step = make_block_step(select, k, use_pallas, accum_dtype)
+
+    out, _ = jax.lax.scan(
+        lambda carry, blk: (step(carry, query_attrs, *blk), None),
+        init, blocks)
+    return out
+
+
+def init_topk(qb: int, k: int, accum_dtype=jnp.float32) -> TopK:
+    """Empty running top-k carry: all slots (+inf, -1, -1)."""
+    return TopK(
         jnp.full((qb, k), jnp.inf, accum_dtype),
         jnp.full((qb, k), -1, jnp.int32),
         jnp.full((qb, k), -1, jnp.int32))
 
-    def step_sort(carry: TopK, blk):
-        battrs, blabels, bids = blk
+
+def make_block_step(select: str, k: int, use_pallas: bool = False,
+                    accum_dtype=jnp.float32):
+    """One running-top-k fold step: (carry, queries, block) -> carry.
+
+    Shared by the in-jit ``lax.scan`` (streaming_topk) and the pipelined
+    per-chunk driver (engine.single), which dispatches one step per data
+    chunk so host->device chunk transfers overlap the previous chunk's
+    compute — the TPU-native replacement for the reference's synchronous
+    Scatterv-then-compute phasing (engine.cpp:62-131 then :233-257).
+    """
+    from dmlp_tpu.ops.distance import masked_pairwise_sq_l2
+
+    def step_sort(carry: TopK, query_attrs, battrs, blabels, bids):
         tile = masked_pairwise_sq_l2(query_attrs, battrs, bids, accum_dtype)
         cand = TopK(tile,
                     jnp.broadcast_to(blabels[None, :], tile.shape),
                     jnp.broadcast_to(bids[None, :], tile.shape))
-        return merge_topk(carry, cand, k), None
+        return merge_topk(carry, cand, k)
 
-    def step_seg(carry: TopK, blk):
+    def merge_cand(carry_, cand_d, cand_l, cand_i):
+        """top_k over carry + candidate columns -> (Qb, k) TopK."""
+        alld = jnp.concatenate([carry_.dists, cand_d], axis=-1)
+        negd, idx = jax.lax.top_k(-alld, k)
+        from_carry = idx < k
+        cidx = jnp.minimum(idx, k - 1)
+        bidx = jnp.maximum(idx - k, 0)
+        labels_ = jnp.where(
+            from_carry, jnp.take_along_axis(carry_.labels, cidx, axis=-1),
+            jnp.take_along_axis(cand_l, bidx, axis=-1))
+        ids_ = jnp.where(
+            from_carry, jnp.take_along_axis(carry_.ids, cidx, axis=-1),
+            jnp.take_along_axis(cand_i, bidx, axis=-1))
+        return TopK(-negd, labels_, ids_)
+
+    def step_seg(carry: TopK, query_attrs, battrs, blabels, bids):
         """Segment-min threshold selection (select="seg").
 
         Exact tile top-k with ~B/128 of the sort work: reduce the tile to
@@ -134,7 +172,6 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
         top_k for that step, so the result is always the exact per-tile
         top-k by distance.
         """
-        battrs, blabels, bids = blk
         from dmlp_tpu.ops.pallas_distance import (fused_dist_segmin,
                                                   native_pallas_backend,
                                                   supports)
@@ -159,21 +196,6 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
         hazard = (s < nseg) & jnp.any(
             jnp.isfinite(sel_min[:, -1]) & (sel_min[:, -1] <= t))
 
-        def merge_cand(carry_, cand_d, cand_l, cand_i):
-            """top_k over carry + candidate columns -> (Qb, k) TopK."""
-            alld = jnp.concatenate([carry_.dists, cand_d], axis=-1)
-            negd, idx = jax.lax.top_k(-alld, k)
-            from_carry = idx < k
-            cidx = jnp.minimum(idx, k - 1)
-            bidx = jnp.maximum(idx - k, 0)
-            labels_ = jnp.where(
-                from_carry, jnp.take_along_axis(carry_.labels, cidx, axis=-1),
-                jnp.take_along_axis(cand_l, bidx, axis=-1))
-            ids_ = jnp.where(
-                from_carry, jnp.take_along_axis(carry_.ids, cidx, axis=-1),
-                jnp.take_along_axis(cand_i, bidx, axis=-1))
-            return TopK(-negd, labels_, ids_)
-
         def full(args):
             carry_, tile_, blabels_, bids_, _ = args
             return merge_cand(carry_, tile_,
@@ -193,14 +215,11 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
             return merge_cand(carry_, cand_d, cand_l, cand_i)
 
         if s == nseg:
-            out = full((carry, tile, blabels, bids, seg_idx))
-        else:
-            out = jax.lax.cond(hazard, full, seg,
-                               (carry, tile, blabels, bids, seg_idx))
-        return out, None
+            return full((carry, tile, blabels, bids, seg_idx))
+        return jax.lax.cond(hazard, full, seg,
+                            (carry, tile, blabels, bids, seg_idx))
 
-    def step_topk(carry: TopK, blk):
-        battrs, blabels, bids = blk
+    def step_topk(carry: TopK, query_attrs, battrs, blabels, bids):
         tile = masked_pairwise_sq_l2(query_attrs, battrs, bids, accum_dtype)
         alld = jnp.concatenate([carry.dists, tile], axis=-1)
         negd, idx = jax.lax.top_k(-alld, k)
@@ -215,12 +234,8 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
         new_ids = jnp.where(
             from_carry, jnp.take_along_axis(carry.ids, cidx, axis=-1),
             bids[bidx])
-        return TopK(-negd, new_labels, new_ids), None
+        return TopK(-negd, new_labels, new_ids)
 
     if select not in ("sort", "topk", "seg"):
         raise ValueError(f"unknown select {select!r}")
-    if select == "seg" and (data_block % 128 != 0 or data_block < 256):
-        select = "topk"  # seg needs whole 128-lane segments to pay off
-    step = {"sort": step_sort, "topk": step_topk, "seg": step_seg}[select]
-    out, _ = jax.lax.scan(step, init, blocks)
-    return out
+    return {"sort": step_sort, "topk": step_topk, "seg": step_seg}[select]

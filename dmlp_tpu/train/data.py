@@ -29,6 +29,31 @@ def teacher_batches(num_attrs: int, num_classes: int, batch_size: int,
         yield x, y
 
 
+def prefetch_to_device(it: Iterator[Tuple[np.ndarray, np.ndarray]],
+                       shardings: Tuple, depth: int = 2,
+                       ) -> Iterator[Tuple]:
+    """Double-buffered device feed: keep ``depth`` batches in flight.
+
+    ``jax.device_put`` is async, so enqueueing the next batch's transfer
+    before the current step's results are consumed overlaps host->device
+    DMA with device compute — without this the train loop eats a full
+    transfer latency per step (the round-1 loop's synchronous per-step
+    device_put, flagged in VERDICT.md "What's weak" #3).
+    """
+    import collections
+
+    import jax
+
+    xsh, ysh = shardings
+    buf: collections.deque = collections.deque()
+    for xy in it:
+        buf.append((jax.device_put(xy[0], xsh), jax.device_put(xy[1], ysh)))
+        if len(buf) >= depth:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()
+
+
 def knn_input_batches(inp, batch_size: int, seed: int = 42,
                       ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Infinite shuffled epochs over a KNNInput's labeled data points."""

@@ -57,16 +57,16 @@ def train(steps: int = 100, batch: int = 1024,
 
     cdtype = jnp.bfloat16 if compute_dtype == "bfloat16" else None
     step_fn = make_train_step(optimizer, cdtype)
-    xsh, ysh = batch_shardings(mesh)
-    data = teacher_batches(dims[0], dims[-1], batch, seed=seed + 1)
+    shardings = batch_shardings(mesh)
+    from dmlp_tpu.train.data import prefetch_to_device
+    data = prefetch_to_device(
+        teacher_batches(dims[0], dims[-1], batch, seed=seed + 1), shardings)
 
     last = {}
     t_window = time.perf_counter()
     window_steps = 0
     for i in range(start_step, start_step + steps):
-        x, y = next(data)
-        xd = jax.device_put(x, xsh)
-        yd = jax.device_put(y, ysh)
+        xd, yd = next(data)
         state, m = step_fn(state, xd, yd)
         window_steps += 1
         if (i + 1) % log_every == 0 or i + 1 == start_step + steps:
