@@ -49,12 +49,17 @@ def train_bench() -> dict:
         dp, tp = os.environ["TRAIN_MESH"].split(",")
         mesh_shape = (int(dp), int(tp))
 
+    offload = os.environ.get("TRAIN_OFFLOAD", "0") == "1"
     mesh = make_train_mesh(mesh_shape)
     n_chips = mesh.devices.size
     optimizer = make_optimizer("sgd", 1e-2)
-    state = build_sharded_state(mesh, dims, optimizer)
+    state = build_sharded_state(mesh, dims, optimizer, offload=offload)
     cdtype = jnp.bfloat16 if dtype == "bfloat16" else None
-    step_fn = make_train_step(optimizer, cdtype)
+    if offload:
+        from dmlp_tpu.train.step import make_offload_train_step
+        step_fn = make_offload_train_step(optimizer, cdtype, state)
+    else:
+        step_fn = make_train_step(optimizer, cdtype)
     xsh, ysh = batch_shardings(mesh)
 
     data = teacher_batches(dims[0], dims[-1], batch, seed=1)
@@ -90,6 +95,7 @@ def train_bench() -> dict:
         "final_loss": round(loss, 4),
         "shape": {"dims": list(dims), "batch": batch, "steps": steps,
                   "dtype": dtype, "n_chips": int(n_chips),
+                  "offload": offload,
                   "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
                   "mode": "train"},
     }

@@ -29,14 +29,25 @@ from dmlp_tpu.train.step import init_state, make_optimizer, make_train_step
 from dmlp_tpu.utils.metrics_log import MetricsLogger
 
 
-def build_sharded_state(mesh, dims, optimizer, seed: int = 0):
+def build_sharded_state(mesh, dims, optimizer, seed: int = 0,
+                        offload: bool = False):
     """Init params on host, place them with the tp/dp shardings, then build
-    the optimizer state on the placed params so moments inherit placement."""
+    the optimizer state on the placed params so moments inherit placement.
+    ``offload`` keeps params (and hence moments) in host DRAM."""
     params = init_mlp(jax.random.PRNGKey(seed), dims)
     placed = jax.tree.map(
         lambda p, s: jax.device_put(p, s), params,
         param_shardings(params, mesh))
-    return init_state(placed, optimizer)
+    state = init_state(placed, optimizer)
+    if offload:
+        # Init in HBM first, then evict: eager zeros_like on a host-memory
+        # array trips a make_array_from_callback memory-kind mismatch in
+        # this JAX, so optimizer moments can't be *created* there directly.
+        to_host = lambda a: jax.device_put(  # noqa: E731
+            a, a.sharding.with_memory_kind("pinned_host"))
+        state["params"] = jax.tree.map(to_host, state["params"])
+        state["opt"] = jax.tree.map(to_host, state["opt"])
+    return state
 
 
 def train(steps: int = 100, batch: int = 1024,
@@ -45,18 +56,22 @@ def train(steps: int = 100, batch: int = 1024,
           compute_dtype: Optional[str] = None, seed: int = 0,
           checkpoint_dir: Optional[str] = None, ckpt_every: int = 100,
           resume: bool = False, metrics: Optional[MetricsLogger] = None,
-          log_every: int = 10):
+          log_every: int = 10, offload: bool = False):
     mesh = make_train_mesh(mesh_shape)
     n_chips = mesh.devices.size
     optimizer = make_optimizer(optimizer_name, lr)
-    state = build_sharded_state(mesh, dims, optimizer, seed)
+    state = build_sharded_state(mesh, dims, optimizer, seed, offload=offload)
     start_step = 0
     if resume and checkpoint_dir and ckpt_lib.latest_step(checkpoint_dir) is not None:
         state = ckpt_lib.restore_checkpoint(checkpoint_dir, state)
         start_step = int(jax.device_get(state["step"]))
 
     cdtype = jnp.bfloat16 if compute_dtype == "bfloat16" else None
-    step_fn = make_train_step(optimizer, cdtype)
+    if offload:
+        from dmlp_tpu.train.step import make_offload_train_step
+        step_fn = make_offload_train_step(optimizer, cdtype, state)
+    else:
+        step_fn = make_train_step(optimizer, cdtype)
     shardings = batch_shardings(mesh)
     from dmlp_tpu.train.data import prefetch_to_device
     data = prefetch_to_device(
@@ -104,6 +119,9 @@ def main(argv=None) -> int:
     p.add_argument("--resume", action="store_true")
     p.add_argument("--metrics-file", default=None)
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--offload", action="store_true",
+                   help="params + optimizer moments in host DRAM, streamed "
+                        "per layer (the bench_4 host-offload analog)")
     args = p.parse_args(argv)
 
     mesh_shape = None
@@ -118,7 +136,8 @@ def main(argv=None) -> int:
         mesh_shape=mesh_shape, optimizer_name=args.optimizer, lr=args.lr,
         compute_dtype=args.compute_dtype, seed=args.seed,
         checkpoint_dir=args.checkpoint_dir, ckpt_every=args.ckpt_every,
-        resume=args.resume, metrics=metrics, log_every=args.log_every)
+        resume=args.resume, metrics=metrics, log_every=args.log_every,
+        offload=args.offload)
     print(f"final: {last}")
     return 0
 

@@ -37,19 +37,30 @@ def make_train_mesh(shape: Optional[Tuple[int, int]] = None,
                 (DP_AXIS, TP_AXIS))
 
 
-def param_shardings(params, mesh: Mesh):
+def param_shardings(params, mesh: Mesh, memory_kind: Optional[str] = None):
     """Megatron-style alternating tp shard: even layers split the output
     dim (column parallel), odd layers the input dim (row parallel); biases
     follow their layer's output split. Replicated over dp, so jitted grads
-    inherit a dp all-reduce."""
+    inherit a dp all-reduce.
+
+    ``memory_kind="pinned_host"`` places params in host DRAM (the bench_4
+    host-offload analog, BASELINE.md "host-DRAM param offload"): the train
+    step then streams each layer to device memory right before its matmul
+    (step.make_train_step(offload=True)) and writes the update back, so HBM
+    never holds the full parameter set.
+    """
     n = len(params)
+
+    def sh(spec):
+        if memory_kind is None:
+            return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, spec, memory_kind=memory_kind)
 
     def spec(i: int):
         col = (i % 2 == 0)
         wspec = P(None, TP_AXIS) if col else P(TP_AXIS, None)
         bspec = P(TP_AXIS) if col else P(None)
-        return {"w": NamedSharding(mesh, wspec),
-                "b": NamedSharding(mesh, bspec)}
+        return {"w": sh(wspec), "b": sh(bspec)}
 
     return {f"layer{i}": spec(i) for i in range(n)}
 
