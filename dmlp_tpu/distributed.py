@@ -1,0 +1,95 @@
+"""Multi-host launcher: the TPU-native ``mpirun ./engine < input``.
+
+One process per host (run_bench.sh:82-84's mpirun analog), each executing::
+
+    python -m dmlp_tpu.distributed --input FILE \
+        [--coordinator HOST:PORT --processes N --process-id I | --auto]
+        [--mode sharded|ring] [--mesh R,C] [--select ...] [--warmup]
+
+Flow per process (parallel.distributed.distributed_contract_run):
+``initialize()`` (the MPI_Init analog) -> sharded file read (each process
+parses only the rows its mesh devices own — no rank-0 ingest,
+cf. common.cpp:93-117) -> per-shard device top-k -> distributed float64
+rescore on the shard-owning process -> host all-gather of the small
+candidate tensors -> merge/vote/report; process 0 prints the canonical
+``Query i checksum: c`` stdout in query order and the ``Time taken: <ms>
+ms`` stderr contract line (common.cpp:70,130).
+
+Managed environments (Cloud TPU pods, SLURM) use ``--auto`` and JAX
+self-detects topology; explicit coordinator flags mirror mpirun's
+rank/size for manual or test launches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from dmlp_tpu.config import EngineConfig
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="dmlp_tpu.distributed",
+                                description=__doc__)
+    p.add_argument("--input", required=True,
+                   help="input file (every process reads its own slice — "
+                        "stdin cannot be sharded)")
+    p.add_argument("--mode", default="sharded", choices=["sharded", "ring"])
+    p.add_argument("--mesh", default=None, help="R,C (data x query axes); "
+                   "default auto-factorizes all devices")
+    p.add_argument("--select", default="auto",
+                   choices=["auto", "sort", "topk", "seg"])
+    p.add_argument("--data-block", type=int, default=None)
+    p.add_argument("--pallas", action="store_true")
+    p.add_argument("--debug", action="store_true")
+    p.add_argument("--warmup", action="store_true",
+                   help="run the solve once untimed first (exclude XLA "
+                        "compile from the contract timing)")
+    p.add_argument("--coordinator", default=None, help="HOST:PORT of "
+                   "process 0 (jax.distributed coordinator)")
+    p.add_argument("--processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--auto", action="store_true",
+                   help="let jax.distributed self-detect topology")
+    args = p.parse_args(argv)
+
+    from dmlp_tpu.parallel.distributed import (distributed_contract_run,
+                                               initialize)
+    initialize(coordinator_address=args.coordinator,
+               num_processes=args.processes, process_id=args.process_id,
+               auto=args.auto)
+
+    mesh_shape = None
+    if args.mesh:
+        r, c = args.mesh.split(",")
+        mesh_shape = (int(r), int(c))
+    config = EngineConfig(mode=args.mode, mesh_shape=mesh_shape,
+                          select=args.select, data_block=args.data_block,
+                          use_pallas=args.pallas, debug=args.debug)
+    from dmlp_tpu.cli import make_engine
+    engine = make_engine(config)
+
+    # stdout is the results channel (checksums only — the grader diffs it,
+    # survey §4); Gloo's C++ collectives print connection banners straight
+    # to fd 1, so fd 1 points at stderr for the whole solve and the real
+    # stdout is restored only for the final canonical report.
+    import io
+    import os
+    real_stdout = os.dup(1)
+    os.dup2(2, 1)
+    buf = io.StringIO()
+    try:
+        distributed_contract_run(args.input, engine, out=buf,
+                                 warmup=args.warmup)
+    finally:
+        sys.stdout.flush()
+        os.dup2(real_stdout, 1)
+        os.close(real_stdout)
+    sys.stdout.write(buf.getvalue())
+    sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,7 +31,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dmlp_tpu.config import EngineConfig
 from dmlp_tpu.engine.finalize import (boundary_overflow, finalize_host,
                                       repair_boundary_overflow)
-from dmlp_tpu.engine.single import fit_blocks, pad_dataset, round_up
+from dmlp_tpu.engine.single import (fit_blocks, pad_dataset, resolve_kcap,
+                                    round_up)
 from dmlp_tpu.io.grammar import KNNInput
 from dmlp_tpu.io.report import QueryResult
 from dmlp_tpu.ops.topk import streaming_topk
@@ -109,11 +110,8 @@ class ShardedEngine:
                                     granule=cfg.resolve_granule(select))
         d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(inp, data_block)
         kmax = int(inp.ks.max()) if inp.params.num_queries else 1
-        extra = cfg.margin if cfg.exact else 0
-        if select in ("topk", "seg"):
-            extra = max(extra, 8)  # detector slack, see single._prep
         shard_rows = d_attrs.shape[0] // r
-        k = max(min(round_up(kmax + extra, 8), shard_rows * r), kmax)
+        k = resolve_kcap(cfg, kmax, select, shard_rows * r)
 
         self._last_select = select  # run() gates the tie-overflow repair
         top = self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
@@ -133,6 +131,18 @@ class ShardedEngine:
         divisible by the data-axis size, query rows by the query-axis
         size). Returns the merged TopK (global, query-sharded).
         """
+        select, data_block, k = self._plan_shard(d_attrs, kmax,
+                                                 merged_width=True)
+        return self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
+                                               q_attrs)
+
+    def _plan_shard(self, d_attrs, kmax: int, merged_width: bool):
+        """Per-shard blocking plan for pre-placed global arrays.
+
+        ``merged_width`` sizes the candidate width for the cross-shard
+        merged output (cap R * shard_rows); per-shard outputs
+        (solve_local_shards) cap at shard_rows. Sets _last_select.
+        """
         from dmlp_tpu.ops.pallas_distance import _tile
 
         cfg = self.config
@@ -140,18 +150,52 @@ class ShardedEngine:
         shard_rows = d_attrs.shape[0] // r
         select = cfg.resolve_select(shard_rows)
         granule = cfg.resolve_granule(select)
-        if cfg.data_block is not None:
-            data_block = min(cfg.data_block, shard_rows)
-        else:
-            data_block = _tile(shard_rows, cfg.resolve_data_block(select),
-                               min(granule, shard_rows))
-        extra = cfg.margin if cfg.exact else 0
-        if select in ("topk", "seg"):
-            extra = max(extra, 8)
-        k = max(min(round_up(kmax + extra, 8), shard_rows * r), kmax)
+        # _tile snaps to the largest granule-multiple divisor of shard_rows
+        # (streaming_topk scans whole blocks, so the block must divide).
+        data_block = _tile(shard_rows,
+                           min(cfg.data_block or
+                               cfg.resolve_data_block(select), shard_rows),
+                           min(granule, shard_rows))
+        k = resolve_kcap(cfg, kmax, select,
+                         shard_rows * r if merged_width else shard_rows)
         self._last_select = select
-        return self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
-                                               q_attrs)
+        return select, data_block, k
+
+    # -- per-shard program (no cross-shard merge) ---------------------------
+    def _fn_local(self, k: int, data_block: int, select: str):
+        """Compiled per-cell top-k with out_specs keeping BOTH mesh axes:
+        output (R, Qpad, K) sharded P("data", "query", None). No collective
+        runs inside the jit — the multi-host contract path rescores each
+        data shard's candidates in float64 on the process that owns the
+        shard, then merges on host (parallel.distributed), so the exact
+        merge must not happen in f32 on device first."""
+        key = ("local", k, data_block, select)
+        if key not in self._fns:
+            use_pallas = self.config.use_pallas
+
+            def local(data_a, data_l, data_i, q_attrs):
+                top = streaming_topk(q_attrs, data_a, data_l, data_i,
+                                     k=k, data_block=data_block,
+                                     select=select, use_pallas=use_pallas)
+                return jax.tree.map(lambda t: t[None], top)  # (1, qloc, K)
+
+            sharded = jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                          P(QUERY_AXIS, None)),
+                out_specs=P(DATA_AXIS, QUERY_AXIS, None),
+                check_vma=False)
+            self._fns[key] = jax.jit(sharded)
+        return self._fns[key]
+
+    def solve_local_shards(self, d_attrs, d_labels, d_ids, q_attrs,
+                           kmax: int):
+        """Like solve_global, but returns per-shard candidate lists
+        (TopK of shape (R, Qpad, K), sharded over both mesh axes)."""
+        select, data_block, k = self._plan_shard(d_attrs, kmax,
+                                                 merged_width=False)
+        return self._fn_local(k, data_block, select)(d_attrs, d_labels,
+                                                     d_ids, q_attrs)
 
     def run(self, inp: KNNInput) -> List[QueryResult]:
         dists, labels, ids = self.candidates(inp)

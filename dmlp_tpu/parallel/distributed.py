@@ -70,14 +70,14 @@ def line_offsets(data: bytes) -> np.ndarray:
     return np.concatenate([[0], nl + 1]).astype(np.int64)
 
 
-def read_data_shard(path: str, num_shards: int, shard: int):
-    """Parse only this shard's data lines (plus all query lines) from the
-    canonical input file.
+def read_row_range(path: str, start: int, stop: int):
+    """Parse data rows [start, stop) (plus all query lines) from the
+    canonical input file — one vectorized newline scan, then the
+    native/Python parser on just the local byte range.
 
-    Returns (params, local_labels, local_attrs, local_start, ks,
-    query_attrs): data arrays cover rows [local_start, local_stop) of the
-    global dataset; queries are replicated (they are small and every
-    process needs them to build the query-axis feed).
+    Returns (params, local_labels, local_attrs, ks, query_attrs); queries
+    are replicated (they are small and every process needs them to build
+    the query-axis feed and to finalize).
     """
     from dmlp_tpu.io.grammar import parse_params
 
@@ -87,8 +87,9 @@ def read_data_shard(path: str, num_shards: int, shard: int):
     header = raw[offs[0]:offs[1]].decode("ascii")
     params = parse_params(header)
     nd = params.num_data
+    stop = min(stop, nd)
+    start = min(start, stop)
 
-    start, stop = shard_bounds(nd, num_shards, shard)
     # Reassemble a small instance: header + local data lines + queries.
     local_bytes = (f"{stop - start} {params.num_queries} {params.num_attrs}\n"
                    .encode("ascii")
@@ -99,8 +100,64 @@ def read_data_shard(path: str, num_shards: int, shard: int):
     import io as _io
     from dmlp_tpu.io.grammar import parse_input
     sub = parse_input(_io.BytesIO(local_bytes))
-    return (params, sub.labels, sub.data_attrs, start, sub.ks,
-            sub.query_attrs)
+    return params, sub.labels, sub.data_attrs, sub.ks, sub.query_attrs
+
+
+def read_data_shard(path: str, num_shards: int, shard: int):
+    """Parse only this shard's (balanced, shard_bounds) data lines plus all
+    query lines. Returns (params, labels, attrs, start, ks, query_attrs)."""
+    with open(path, "rb") as f:
+        header = f.readline().decode("ascii")
+    from dmlp_tpu.io.grammar import parse_params
+    nd = parse_params(header).num_data
+    start, stop = shard_bounds(nd, num_shards, shard)
+    params, labels, attrs, ks, q_attrs = read_row_range(path, start, stop)
+    return params, labels, attrs, start, ks, q_attrs
+
+
+def process_slice(sharding, global_shape) -> Tuple[int, int]:
+    """This process's contiguous [start, stop) block along axis 0, derived
+    from the sharding itself.
+
+    ``shard_bounds(process_id)``-style arithmetic silently assumes process
+    boundaries align with mesh-axis positions; on a mesh where one
+    process's devices span several positions of an axis (2 processes x 4
+    devices on a (4, 2) mesh) that assumption feeds the wrong rows. The
+    sharding's own ``addressable_devices_indices_map`` is the ground truth
+    for what this process must supply. Raises if the addressable block is
+    not contiguous (a mesh/process layout this feed does not support).
+    """
+    imap = sharding.addressable_devices_indices_map(tuple(global_shape))
+    spans = sorted({(idx[0].start or 0,
+                     global_shape[0] if idx[0].stop is None else idx[0].stop)
+                    for idx in imap.values()})
+    lo, hi = spans[0][0], max(e for _, e in spans)
+    cur = lo
+    for s, e in spans:
+        if s > cur:
+            raise ValueError(
+                f"process-addressable block not contiguous: gap [{cur},{s}) "
+                f"(spans {spans}); choose a mesh whose data/query axes align "
+                "with process boundaries")
+        cur = max(cur, e)
+    return lo, hi
+
+
+def build_global(sharding, global_shape, local_np: np.ndarray, lo: int):
+    """Assemble a global array from this process's local block.
+
+    ``local_np`` holds rows [lo, lo + len) of axis 0 (the process's
+    process_slice block); every other axis is full-size. The callback form
+    serves exactly the shards this process's devices need — the declarative
+    Scatterv, correct for any process-to-mesh layout.
+    """
+    def cb(index):
+        sl = index[0]
+        start = sl.start or 0
+        stop = global_shape[0] if sl.stop is None else sl.stop
+        return local_np[start - lo:stop - lo]
+
+    return jax.make_array_from_callback(tuple(global_shape), sharding, cb)
 
 
 def padded_shard(labels: np.ndarray, attrs: np.ndarray, start: int,
@@ -121,43 +178,229 @@ def padded_shard(labels: np.ndarray, attrs: np.ndarray, start: int,
     return out_attrs, out_labels, out_ids
 
 
-def sharded_solve_from_file(path: str, engine, num_processes: int = 1,
-                            process_id: int = 0):
-    """Whole multi-host feed: offset-indexed shard read -> uniform padding
-    -> global mesh arrays -> the engine's compiled sharded program.
-
-    Each process parses only its slice of the input file and contributes it
-    via make_global_dataset — no host ever ingests the full dataset (the
-    survey's rank-0 bottleneck). Queries are replicated per process and
-    sharded over the "query" axis. Returns (TopK, params, ks) — the caller
-    finalizes (on one host with the full f64 data for exact mode, or
-    per-shard in fast mode).
-    """
+def plan_shapes(engine, n: int, nq: int):
+    """Global padded shapes for the sharded feed — identical on every
+    process (pure function of the header + engine config/mesh)."""
     from dmlp_tpu.engine.single import round_up
 
-    mesh = engine.mesh
-    r, c = mesh.devices.shape
-    params, labels, attrs, start, ks, q_attrs = read_data_shard(
-        path, num_processes, process_id)
-    # Uniform local rows, and the r mesh shards must divide the global row
-    # count: round the per-process rows so num_processes * rows % r == 0.
-    rows = round_up(-(-params.num_data // num_processes), 8 * r)
-    p_attrs, p_labels, p_ids = padded_shard(labels, attrs, start, rows)
-    ga, gl, gi = make_global_dataset(mesh, p_attrs, p_labels, p_ids)
-
-    nq = params.num_queries
+    cfg = engine.config
+    r, c = engine.mesh.devices.shape
+    select = cfg.resolve_select(round_up(max(-(-n // r), 1), 8))
+    granule = cfg.resolve_granule(select)
+    shard_rows = round_up(max(-(-n // r), 1), granule)
     qpad = c * round_up(max(-(-nq // c), 1), 8)
-    assert qpad % num_processes == 0, \
-        f"padded query count {qpad} must divide across {num_processes} procs"
-    q_local = np.zeros((qpad // num_processes, q_attrs.shape[1]), np.float32)
-    lo, hi = shard_bounds(qpad, num_processes, process_id)
-    src = q_attrs[lo:min(hi, nq)]
-    q_local[:src.shape[0]] = src
-    gq = make_global_queries(mesh, q_local)
+    return r * shard_rows, shard_rows, qpad
 
-    kmax = int(ks.max()) if nq else 1
+
+def stage_global_inputs(path: str, engine):
+    """Per-process sharded file read -> global mesh arrays.
+
+    Each process derives its data/query blocks from the shardings
+    themselves (process_slice), parses only those file rows, and serves
+    them shard-by-shard (build_global) — no host ever ingests the full
+    dataset (the survey's rank-0 bottleneck, common.cpp:93-117).
+
+    Returns (ga, gl, gi, gq, params, ks, local), where ``local`` carries
+    what finalization needs later: this process's f64 data block + offset
+    and the full f64 query attrs.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = engine.mesh
+    with open(path, "rb") as f:
+        header = f.readline().decode("ascii")
+    from dmlp_tpu.io.grammar import parse_params
+    hdr = parse_params(header)
+    n, nq, na = hdr.num_data, hdr.num_queries, hdr.num_attrs
+    npad, shard_rows, qpad = plan_shapes(engine, n, nq)
+
+    dsh2 = NamedSharding(mesh, P(DATA_AXIS, None))
+    dsh1 = NamedSharding(mesh, P(DATA_AXIS))
+    qsh = NamedSharding(mesh, P(QUERY_AXIS, None))
+
+    dlo, dhi = process_slice(dsh2, (npad, na))
+    params, labels, attrs, ks, q_attrs = read_row_range(path, dlo, dhi)
+    p_attrs, p_labels, p_ids = padded_shard(labels, attrs, dlo, dhi - dlo)
+
+    ga = build_global(dsh2, (npad, na), p_attrs, dlo)
+    gl = build_global(dsh1, (npad,), p_labels, dlo)
+    gi = build_global(dsh1, (npad,), p_ids, dlo)
+
+    qlo, qhi = process_slice(qsh, (qpad, na))
+    q_local = np.zeros((qhi - qlo, na), np.float32)
+    src = q_attrs[qlo:min(qhi, nq)]
+    q_local[:src.shape[0]] = src
+    gq = build_global(qsh, (qpad, na), q_local, qlo)
+
+    local = {"data_attrs": attrs, "data_labels": labels, "offset": dlo,
+             "shard_rows": shard_rows, "query_attrs": q_attrs}
+    return ga, gl, gi, gq, params, ks, local
+
+
+def sharded_solve_from_file(path: str, engine):
+    """Whole multi-host feed: sharded read -> global arrays -> the engine's
+    compiled sharded (merged) program. Returns (TopK, params, ks) — the
+    caller finalizes. For the full contract run (distributed f64 rescore +
+    rank-0 report) use distributed_contract_run instead.
+    """
+    ga, gl, gi, gq, params, ks, _ = stage_global_inputs(path, engine)
+    kmax = int(ks.max()) if params.num_queries else 1
     top = engine.solve_global(ga, gl, gi, gq, kmax)
     return top, params, ks
+
+
+def _exact_shard_topk(q64: np.ndarray, d64: np.ndarray, labels: np.ndarray,
+                      id_base: np.ndarray, k: int):
+    """Exact f64 top-k of one query over one data shard, by the selection
+    total order (dist asc, label desc, id desc). The per-query repair for
+    f32 tie-boundary hazards — all inputs are local to the owning process.
+    """
+    diff = d64 - q64[None, :]
+    dist = np.einsum("na,na->n", diff, diff)
+    order = np.lexsort((-id_base, -labels, dist))[:k]
+    out_d = np.full(k, np.inf)
+    out_l = np.full(k, -1, np.int32)
+    out_i = np.full(k, -1, np.int32)
+    m = len(order)
+    out_d[:m] = dist[order]
+    out_l[:m] = labels[order]
+    out_i[:m] = id_base[order]
+    return out_d, out_l, out_i
+
+
+def rescore_local_shards(top, local, ks: np.ndarray, nq: int):
+    """Distributed float64 rescore: each process rescores the candidates of
+    the data shards it owns, using only its own f64 rows.
+
+    ``top`` is the (R, Qpad, K) per-shard TopK from solve_local_shards.
+    Returns (R, Qpad, K) numpy arrays (f64 dists / labels / ids) holding
+    this process's cells, +inf/-1 elsewhere — elementwise min/max across
+    processes then reconstructs the full tensors (each cell has exactly one
+    owner). Per-shard f32 tie-boundary hazards (candidate truncation, see
+    engine.finalize.boundary_overflow) are repaired here from local f64
+    data, so no golden-model pass over the full dataset is ever needed.
+    """
+    r_axis, qpad, kcap = top.dists.shape
+    my_d = np.full((r_axis, qpad, kcap), np.inf)
+    my_l = np.full((r_axis, qpad, kcap), -1, np.int32)
+    my_i = np.full((r_axis, qpad, kcap), -1, np.int32)
+
+    attrs64 = np.asarray(local["data_attrs"], np.float64)
+    labels_loc = np.asarray(local["data_labels"])
+    offset, shard_rows = local["offset"], local["shard_rows"]
+    q64 = np.asarray(local["query_attrs"], np.float64)
+    nreal = attrs64.shape[0]
+    ks = np.asarray(ks)
+
+    d_shards = {(s.index[0].start or 0, s.index[1].start or 0): s
+                for s in top.dists.addressable_shards}
+    l_shards = {(s.index[0].start or 0, s.index[1].start or 0): s
+                for s in top.labels.addressable_shards}
+    for s in top.ids.addressable_shards:
+        r0 = s.index[0].start or 0
+        q0 = s.index[1].start or 0
+        qs = s.index[1]
+        q1 = qpad if qs.stop is None else qs.stop
+        ids_blk = np.array(s.data)[0]                      # (qloc, K), owned
+        f32_blk = np.asarray(d_shards[(r0, q0)].data)[0]
+        lab_blk = np.array(l_shards[(r0, q0)].data)[0]
+        qrows = np.arange(q0, q1)
+
+        if nreal == 0 or nq == 0:
+            # All-padding shard (small n on a wide mesh) or no queries:
+            # every candidate is a sentinel; nothing to rescore.
+            my_d[r0, q0:q1] = np.inf
+            my_l[r0, q0:q1] = -1
+            my_i[r0, q0:q1] = -1
+            continue
+
+        # f64 rescore of this shard's candidates (ids are global rows in
+        # [offset, offset + nreal) or -1); padded query rows (>= nq) score
+        # against query 0 and are discarded at finalize.
+        safe = np.clip(ids_blk - offset, 0, nreal - 1)
+        gathered = attrs64[safe]                           # (qloc, K, A)
+        diff = gathered - q64[np.minimum(qrows, nq - 1)][:, None, :]
+        d64 = np.einsum("qka,qka->qk", diff, diff)
+        d64[ids_blk < 0] = np.inf
+
+        # Per-shard tie-boundary repair, from local f64 data only.
+        ks_blk = np.minimum(ks[np.minimum(qrows, max(nq - 1, 0))], kcap)
+        kth = f32_blk[np.arange(q1 - q0), np.clip(ks_blk - 1, 0, kcap - 1)]
+        hazard = np.isfinite(f32_blk[:, -1]) & (f32_blk[:, -1] == kth) \
+            & (qrows < nq) & (kcap < nreal)
+        if hazard.any():
+            sh_lo = r0 * shard_rows - offset
+            sh_hi = min(sh_lo + shard_rows, nreal)
+            base_ids = np.arange(offset + sh_lo, offset + sh_hi,
+                                 dtype=np.int32)
+            for j in np.nonzero(hazard)[0]:
+                d64[j], lab_blk[j], ids_blk[j] = _exact_shard_topk(
+                    q64[qrows[j]], attrs64[sh_lo:sh_hi],
+                    labels_loc[sh_lo:sh_hi], base_ids, kcap)
+
+        my_d[r0, q0:q1] = d64
+        my_l[r0, q0:q1] = lab_blk
+        my_i[r0, q0:q1] = ids_blk
+    return my_d, my_l, my_i
+
+
+def distributed_contract_run(path: str, engine, out=None, err=None,
+                             warmup: bool = False):
+    """The end-to-end multi-host contract run — the TPU-native form of
+    ``mpirun ./engine < input`` (common.cpp:81-135 + run_bench.sh:82-84).
+
+    Per process: sharded file read (no rank-0 ingest) -> per-shard device
+    top-k (no f32 cross-shard merge) -> distributed f64 rescore + tie
+    repair on the owning process -> host all-gather of the tiny candidate
+    tensors -> every process merges/finalizes, process 0 prints the
+    canonical stdout in query order + the ``Time taken`` stderr line.
+    No host ever touches the full f64 dataset.
+    """
+    import sys
+    import time
+
+    from dmlp_tpu.engine.finalize import finalize_host
+    from dmlp_tpu.io.report import format_results
+
+    out = out if out is not None else sys.stdout
+    err = err if err is not None else sys.stderr
+
+    def solve():
+        ga, gl, gi, gq, params, ks, local = stage_global_inputs(path, engine)
+        nq = params.num_queries
+        kmax = int(ks.max()) if nq else 1
+        top = engine.solve_local_shards(ga, gl, gi, gq, kmax)
+        my_d, my_l, my_i = rescore_local_shards(top, local, ks, nq)
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            all_d = multihost_utils.process_allgather(my_d)
+            all_l = multihost_utils.process_allgather(my_l)
+            all_i = multihost_utils.process_allgather(my_i)
+            my_d = all_d.min(axis=0)
+            my_l = all_l.max(axis=0)
+            my_i = all_i.max(axis=0)
+
+        # (R, Qpad, K) -> (Q, R*K): per query, all shards' candidates.
+        r_axis, qpad, kcap = my_d.shape
+        flat = lambda x: x.transpose(1, 0, 2).reshape(qpad, r_axis * kcap)  # noqa: E731
+        results = finalize_host(flat(my_d)[:nq], flat(my_l)[:nq],
+                                flat(my_i)[:nq], ks,
+                                local["query_attrs"], None, exact=False)
+        return results
+
+    if warmup:
+        solve()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("dmlp_tpu.contract.start")
+    t0 = time.perf_counter()
+    results = solve()
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    if jax.process_index() == 0:
+        out.write(format_results(results, debug=engine.config.debug))
+        err.write(f"Time taken: {int(round(elapsed_ms))} ms\n")
+    return results
 
 
 def make_global_dataset(mesh: jax.sharding.Mesh, local_attrs: np.ndarray,

@@ -1,0 +1,146 @@
+"""End-to-end multi-host contract run (VERDICT r1 item 2).
+
+Spawns real OS processes that form a jax.distributed CPU cluster (Gloo
+collectives), each seeing its own virtual devices — the closest a single
+host gets to the reference's 2-node mpirun operating mode
+(run_bench.sh:82-84). Process 0's stdout must be byte-identical to the
+golden oracle's.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.engine.sharded import ShardedEngine
+from dmlp_tpu.golden.reference import knn_golden
+from dmlp_tpu.io.datagen import generate_input_text
+from dmlp_tpu.io.grammar import parse_input_text
+from dmlp_tpu.io.report import format_results
+from dmlp_tpu.parallel.mesh import make_mesh
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(input_path, port, nprocs, pid, devices_per_proc, extra=()):
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_proc}")
+    return subprocess.Popen(
+        [sys.executable, "-m", "dmlp_tpu.distributed",
+         "--input", str(input_path),
+         "--coordinator", f"localhost:{port}",
+         "--processes", str(nprocs), "--process-id", str(pid), *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.parametrize("extra", [(), ("--select", "topk")])
+def test_two_process_contract_run_matches_golden(tmp_path, extra):
+    text = generate_input_text(211, 23, 5, -4, 4, 1, 12, 4, seed=9)
+    path = tmp_path / "in.txt"
+    path.write_text(text)
+    want = format_results(knn_golden(parse_input_text(text)))
+
+    port = _free_port()
+    procs = [_spawn(path, port, 2, pid, devices_per_proc=2, extra=extra)
+             for pid in (0, 1)]
+    outs = [p.communicate(timeout=240) for p in procs]
+    assert all(p.returncode == 0 for p in procs), \
+        [o[1].decode()[-2000:] for o in outs]
+    assert outs[0][0].decode() == want          # proc 0: canonical stdout
+    assert outs[1][0].decode() == ""            # proc 1: silent
+    assert "Time taken:" in outs[0][1].decode()  # contract stderr line
+
+
+def test_process_slice_matches_addressable_shards():
+    """process_slice must agree with what the sharding actually assigns
+    (the ADVICE r1 item: no shard_bounds-style process/axis assumptions)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dmlp_tpu.parallel.distributed import process_slice
+
+    mesh = make_mesh()  # (4, 2) over the 8 virtual devices
+    npad = 64
+    sh = NamedSharding(mesh, P("data", None))
+    lo, hi = process_slice(sh, (npad, 3))
+    # single process: the addressable block is the whole axis
+    assert (lo, hi) == (0, npad)
+    qsh = NamedSharding(mesh, P("query", None))
+    assert process_slice(qsh, (16, 3)) == (0, 16)
+
+
+def test_contract_run_single_process_matches_golden(tmp_path, capsys):
+    """The same entry point, degenerate single-process form, all selects."""
+    from dmlp_tpu.parallel.distributed import distributed_contract_run
+
+    text = generate_input_text(97, 11, 4, 0, 9, 1, 30, 3, seed=4)
+    path = tmp_path / "in.txt"
+    path.write_text(text)
+    inp = parse_input_text(text)
+    want = [r.checksum() for r in knn_golden(inp)]
+
+    for select in ("sort", "topk", "seg"):
+        engine = ShardedEngine(
+            EngineConfig(mode="sharded", select=select, query_block=8),
+            mesh=make_mesh())
+        got = distributed_contract_run(str(path), engine,
+                                       out=open(os.devnull, "w"),
+                                       err=open(os.devnull, "w"))
+        assert [r.checksum() for r in got] == want, select
+
+
+def test_distributed_rescore_repairs_duplicate_ties(tmp_path):
+    """Adversarial duplicate-heavy data: every point identical, so every
+    shard's f32 tie boundary overflows and the per-shard f64 repair path
+    must fire — and still match golden."""
+    from dmlp_tpu.parallel.distributed import distributed_contract_run
+
+    n, q, a = 96, 8, 3
+    lines = [f"{n} {q} {a}"]
+    for i in range(n):
+        lines.append(" ".join([str(i % 4)] + ["1.000000"] * a))
+    for _ in range(q):
+        lines.append("Q 7 " + " ".join(["1.000000"] * a))
+    text = "\n".join(lines) + "\n"
+    path = tmp_path / "dup.txt"
+    path.write_text(text)
+    inp = parse_input_text(text)
+    want = [r.checksum() for r in knn_golden(inp)]
+
+    engine = ShardedEngine(
+        EngineConfig(mode="sharded", select="topk", query_block=8,
+                     data_block=16),
+        mesh=make_mesh())
+    got = distributed_contract_run(str(path), engine,
+                                   out=open(os.devnull, "w"),
+                                   err=open(os.devnull, "w"))
+    assert [r.checksum() for r in got] == want
+
+
+def test_two_process_tiny_input_empty_shard(tmp_path):
+    """num_data small enough that one process's padded block holds no real
+    rows at all — the all-sentinel shard path must not crash and the
+    output must still match golden."""
+    text = generate_input_text(10, 5, 3, -2, 2, 1, 10, 3, seed=2)
+    path = tmp_path / "tiny.txt"
+    path.write_text(text)
+    want = format_results(knn_golden(parse_input_text(text)))
+
+    port = _free_port()
+    procs = [_spawn(path, port, 2, pid, devices_per_proc=4) for pid in (0, 1)]
+    outs = [p.communicate(timeout=240) for p in procs]
+    assert all(p.returncode == 0 for p in procs), \
+        [o[1].decode()[-2000:] for o in outs]
+    assert outs[0][0].decode() == want
