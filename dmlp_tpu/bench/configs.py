@@ -41,7 +41,7 @@ BENCH_CONFIGS: Dict[int, BenchConfig] = {
     2: BenchConfig(2, 100_000, 5_000, 64, 0.0, 100.0, 1, 32, 10, 42,
                    "input2.in"),
     3: BenchConfig(3, 100_000, 5_000, 64, 0.0, 100.0, 1, 32, 10, 42,
-                   "input2.in", mode="sharded"),
+                   "input2.in", mode="sharded", mesh_shape=(4, 2)),
     4: BenchConfig(4, 200_000, 10_000, 64, 0.0, 100.0, 1, 32, 10, 42,
                    "input3.in"),
 }

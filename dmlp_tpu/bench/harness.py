@@ -15,7 +15,6 @@ The TPU-native run_bench.sh. Per config (configs.py):
 
 from __future__ import annotations
 
-import io
 import os
 import re
 import time
@@ -105,39 +104,62 @@ def ensure_oracle(cfg: BenchConfig, input_path: str, outputs_dir: str,
     return out_path, err_path
 
 
+class EngineTimeout(RuntimeError):
+    """The engine subprocess exceeded the harness timeout and was killed."""
+
+
 def run_engine(cfg: BenchConfig, input_path: str, outputs_dir: str,
                mode: Optional[str] = None, fast: bool = False,
-               warmup: bool = True) -> tuple[str, str]:
-    """Run the engine CLI on the input; returns (tmp.out, tmp.err) paths.
+               warmup: bool = True, timeout_s: float = 300.0,
+               env: Optional[dict] = None) -> tuple[str, str]:
+    """Run the engine CLI as a subprocess over a real pipe, under a kill
+    timeout; returns (tmp.out, tmp.err) paths.
 
-    Defaults to exact (f64-parity) mode — the harness exists to prove
-    checksum parity, like the reference's oracle diff; ``fast=True`` drops
-    the host rescore for pure-device timing at the cost of f32 ordering.
+    A subprocess + timeout mirrors the reference's hang protection
+    (``mpirun --timeout 300``, run_bench.sh:82) — one wedged jit must fail
+    its config, not block the whole suite. Defaults to exact (f64-parity)
+    mode — the harness exists to prove checksum parity, like the
+    reference's oracle diff; ``fast=True`` drops the host rescore for
+    pure-device timing at the cost of f32 ordering. ``cfg.mesh_shape``
+    (run_bench.sh's task-count analog) is passed through as ``--mesh``.
     """
-    from dmlp_tpu.cli import main as cli_main
+    import subprocess
+    import sys
 
-    argv = ["--mode", mode or cfg.mode]
+    argv = [sys.executable, "-m", "dmlp_tpu", "--mode", mode or cfg.mode]
+    if cfg.mesh_shape is not None and (mode or cfg.mode) != "single":
+        argv += ["--mesh", f"{cfg.mesh_shape[0]},{cfg.mesh_shape[1]}"]
     if fast:
         argv.append("--fast")
     if warmup:
         argv.append("--warmup")
-    out_buf, err_buf = io.StringIO(), io.StringIO()
-    with open(input_path) as stdin:
-        rc = cli_main(argv, stdin=stdin, stdout=out_buf, stderr=err_buf)
-    if rc != 0:
-        raise RuntimeError(f"engine CLI exited {rc}")
+    with open(input_path, "rb") as stdin:
+        proc = subprocess.Popen(argv, stdin=stdin, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, env=env)
+        try:
+            out_b, err_b = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            raise EngineTimeout(
+                f"engine exceeded {timeout_s:.0f}s timeout (killed), "
+                f"cf. mpirun --timeout at run_bench.sh:82")
+    if proc.returncode != 0:
+        raise RuntimeError(f"engine CLI exited {proc.returncode}: "
+                           f"{err_b.decode()[-2000:]}")
     tmp_out = os.path.join(outputs_dir, "tmp.out")
     tmp_err = os.path.join(outputs_dir, "tmp.err")
-    with open(tmp_out, "w") as f:
-        f.write(out_buf.getvalue())
-    with open(tmp_err, "w") as f:
-        f.write(err_buf.getvalue())
+    with open(tmp_out, "wb") as f:
+        f.write(out_b)
+    with open(tmp_err, "wb") as f:
+        f.write(err_b)
     return tmp_out, tmp_err
 
 
 def run_config(config_id: int, base_dir: str = ".",
                mode: Optional[str] = None, fast: bool = False,
                force_oracle: bool = False, out: Optional[TextIO] = None,
+               timeout_s: float = 300.0, env: Optional[dict] = None,
                ) -> dict:
     """Full benchmark flow for one config; returns a result summary dict."""
     import sys
@@ -150,8 +172,22 @@ def run_config(config_id: int, base_dir: str = ".",
     input_path = ensure_input(cfg, inputs_dir)
     oracle_out, oracle_err = ensure_oracle(cfg, input_path, outputs_dir, out,
                                            force=force_oracle)
-    engine_out, engine_err = run_engine(cfg, input_path, outputs_dir,
-                                        mode=mode, fast=fast)
+    try:
+        engine_out, engine_err = run_engine(cfg, input_path, outputs_dir,
+                                            mode=mode, fast=fast,
+                                            timeout_s=timeout_s, env=env)
+    except EngineTimeout as e:
+        out.write(f"Config {config_id}: TIMEOUT ({e})\n")
+        return {"config": config_id, "checksums_match": False,
+                "timeout": True, "oracle_ms": None, "engine_ms": None,
+                "percent_vs_oracle": None}
+    except RuntimeError as e:
+        # A crashing engine fails its config, not the whole suite — the
+        # same isolation the timeout gives a hung one.
+        out.write(f"Config {config_id}: ERROR ({e})\n")
+        return {"config": config_id, "checksums_match": False,
+                "error": str(e), "oracle_ms": None, "engine_ms": None,
+                "percent_vs_oracle": None}
 
     with open(oracle_out) as f:
         want = f.read()
@@ -185,13 +221,17 @@ def main(argv=None) -> int:
                         "diffs vs the f64 oracle are then expected)")
     p.add_argument("--force-oracle", action="store_true")
     p.add_argument("--base-dir", default=".")
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="per-config engine kill timeout in seconds "
+                        "(mpirun --timeout 300 analog)")
     args = p.parse_args(argv)
 
     ids = list(BENCH_CONFIGS) if args.config == "all" else [int(args.config)]
     ok = True
     for cid in ids:
         res = run_config(cid, base_dir=args.base_dir, mode=args.mode,
-                         fast=args.fast, force_oracle=args.force_oracle)
+                         fast=args.fast, force_oracle=args.force_oracle,
+                         timeout_s=args.timeout)
         ok = ok and res["checksums_match"]
     return 0 if ok else 1
 

@@ -15,6 +15,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import sys
 from typing import IO, Optional, Sequence
 
@@ -24,17 +25,47 @@ from dmlp_tpu.io.report import format_results
 from dmlp_tpu.utils.timing import EngineTimer
 
 
-def make_engine(config: EngineConfig):
-    """Engine registry (lazy imports keep CLI start light)."""
+def parse_mesh_arg(parser, value):
+    """Validate an R,C mesh flag (argparse usage error, not a traceback)."""
+    if not value:
+        return None
+    parts = value.split(",")
+    if len(parts) != 2 or not all(p.strip().lstrip("-").isdigit()
+                                  for p in parts):
+        parser.error(f"--mesh expects R,C (two integers), got {value!r}")
+    r, c = int(parts[0]), int(parts[1])
+    if r <= 0 or c <= 0:
+        parser.error(f"--mesh axes must be positive, got {value!r}")
+    return (r, c)
+
+
+def make_engine(config: EngineConfig, stderr=None):
+    """Engine registry (lazy imports keep CLI start light).
+
+    An explicit mesh_shape that needs more devices than this host has
+    falls back to the auto-factorized mesh with a stderr warning — bench
+    configs carry mesh hints sized for their target topology (the
+    run_bench.sh task-count analog), and a portable harness must still run
+    (degraded, visibly) on smaller hosts.
+    """
     if config.mode == "single":
         from dmlp_tpu.engine.single import SingleChipEngine
         return SingleChipEngine(config)
-    if config.mode == "sharded":
-        from dmlp_tpu.engine.sharded import ShardedEngine
-        return ShardedEngine(config)
-    if config.mode == "ring":
-        from dmlp_tpu.engine.ring import RingEngine
-        return RingEngine(config)
+    if config.mode in ("sharded", "ring"):
+        if config.mode == "sharded":
+            from dmlp_tpu.engine.sharded import ShardedEngine as cls
+        else:
+            from dmlp_tpu.engine.ring import RingEngine as cls
+        if config.mesh_shape is not None:
+            import jax
+            r, c = config.mesh_shape
+            if r * c > len(jax.devices()):
+                import sys as _sys
+                (stderr or _sys.stderr).write(
+                    f"warning: mesh {r},{c} needs {r * c} devices, have "
+                    f"{len(jax.devices())}; using auto mesh\n")
+                config = dataclasses.replace(config, mesh_shape=None)
+        return cls(config)
     raise ValueError(f"unknown mode {config.mode!r}")
 
 
@@ -45,6 +76,10 @@ def main(argv: Optional[Sequence[str]] = None,
     parser = argparse.ArgumentParser(prog="dmlp_tpu", description=__doc__)
     parser.add_argument("--mode", default="single",
                         choices=["single", "sharded", "ring"])
+    parser.add_argument("--mesh", default=None, metavar="R,C",
+                        help="mesh shape (data x query axes) for the "
+                             "sharded/ring engines; default auto-factorizes "
+                             "all devices (MPI_Dims_create analog)")
     parser.add_argument("--engine", default="jax", choices=["jax", "golden"],
                         help="'golden' runs the NumPy oracle (differential "
                              "testing reference)")
@@ -80,13 +115,12 @@ def main(argv: Optional[Sequence[str]] = None,
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
 
-    if args.device_full and args.mode != "single":
-        parser.error("--device-full currently supports --mode single only")
-
+    mesh_shape = parse_mesh_arg(parser, args.mesh)
     config = EngineConfig(mode=args.mode, debug=args.debug,
                           exact=not args.fast, data_block=args.data_block,
                           query_block=args.query_block, dtype=args.dtype,
-                          select=args.select, use_pallas=args.pallas)
+                          select=args.select, use_pallas=args.pallas,
+                          mesh_shape=mesh_shape)
 
     timer = EngineTimer()
     with timer.phase("parse"):
@@ -99,7 +133,7 @@ def main(argv: Optional[Sequence[str]] = None,
         from dmlp_tpu.golden.reference import knn_golden
         results = knn_golden(inp)
     else:
-        engine = make_engine(config)
+        engine = make_engine(config, stderr=stderr)
         solve = engine.run_device_full if args.device_full else engine.run
         if args.warmup:
             with timer.phase("warmup_compile"):

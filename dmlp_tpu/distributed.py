@@ -60,14 +60,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                num_processes=args.processes, process_id=args.process_id,
                auto=args.auto)
 
-    mesh_shape = None
-    if args.mesh:
-        r, c = args.mesh.split(",")
-        mesh_shape = (int(r), int(c))
+    from dmlp_tpu.cli import make_engine, parse_mesh_arg
+    mesh_shape = parse_mesh_arg(p, args.mesh)
     config = EngineConfig(mode=args.mode, mesh_shape=mesh_shape,
                           select=args.select, data_block=args.data_block,
                           use_pallas=args.pallas, debug=args.debug)
-    from dmlp_tpu.cli import make_engine
     engine = make_engine(config)
 
     # stdout is the results channel (checksums only — the grader diffs it,

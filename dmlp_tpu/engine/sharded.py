@@ -212,12 +212,78 @@ class ShardedEngine:
                 repair_boundary_overflow(results, suspects, inp)
         return results
 
+    def _fn_full(self, k: int, data_block: int, select: str,
+                 num_labels: int):
+        """Compiled all-device pipeline: per-cell top-k -> cross-shard
+        merge -> vote + report ordering, all query-sharded on device (the
+        sharded analog of single._full_blocks)."""
+        key = ("full", k, data_block, select, num_labels)
+        if key not in self._fns:
+            merge = self._merge_strategy
+            use_pallas = self.config.use_pallas
+
+            def local(data_a, data_l, data_i, q_attrs, ks):
+                from dmlp_tpu.ops.vote import majority_vote, report_order
+
+                top = streaming_topk(q_attrs, data_a, data_l, data_i,
+                                     k=k, data_block=data_block,
+                                     select=select, use_pallas=use_pallas)
+                if merge == "allgather":
+                    top = allgather_merge_topk(top, k, DATA_AXIS)
+                else:
+                    top = ring_allreduce_topk(top, k, DATA_AXIS)
+                rd, rids, in_k = report_order(top, ks)
+                valid = in_k & (top.ids >= 0)
+                predicted = majority_vote(top.labels, valid, num_labels)
+                return predicted, rids, rd
+
+            sharded = jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                          P(QUERY_AXIS, None), P(QUERY_AXIS)),
+                out_specs=(P(QUERY_AXIS), P(QUERY_AXIS, None),
+                           P(QUERY_AXIS, None)),
+                check_vma=False)
+            self._fns[key] = jax.jit(sharded)
+        return self._fns[key]
+
     def run_device_full(self, inp: KNNInput) -> List[QueryResult]:
-        # Device-side vote/report for the sharded path lands with the bench
-        # harness; the parity pipeline (candidates + host finalize) is the
-        # contract path.
-        raise NotImplementedError(
-            "use run(); device-full sharded pipeline not yet implemented")
+        """All-device pipeline over the mesh (vote + report order on the
+        chips, f32 ordering; benchmark path — no float64 rescue)."""
+        cfg = self.config
+        n = inp.params.num_data
+        r, c = self.mesh.devices.shape
+        shard_rows_est = round_up(max(-(-n // r), 1), 8)
+        select = cfg.resolve_select(shard_rows_est)
+        if cfg.data_block is not None:
+            data_block = min(cfg.data_block, shard_rows_est)
+        else:
+            data_block = fit_blocks(max(-(-n // r), 1),
+                                    cfg.resolve_data_block(select),
+                                    granule=cfg.resolve_granule(select))
+        d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(inp, data_block)
+        nq = inp.params.num_queries
+        qpad = q_attrs.shape[0]
+        kmax = int(inp.ks.max()) if nq else 1
+        shard_rows = d_attrs.shape[0] // r
+        k = resolve_kcap(cfg, kmax, select, shard_rows * r)
+        num_labels = int(inp.labels.max()) + 1 if n else 1
+        self._last_select = select
+
+        ks_pad = np.zeros(qpad, np.int32)
+        ks_pad[:nq] = inp.ks
+        ksh = NamedSharding(self.mesh, P(QUERY_AXIS))
+        ks_dev = jax.device_put(jnp.asarray(ks_pad), ksh)
+
+        p, i, d = self._fn_full(k, data_block, select, num_labels)(
+            d_attrs, d_labels, d_ids, q_attrs, ks_dev)
+        preds = np.asarray(p)[:nq]
+        rids = np.asarray(i)[:nq]
+        rd = np.asarray(d, np.float64)[:nq]
+        return [QueryResult(qi, int(inp.ks[qi]), int(preds[qi]),
+                            rids[qi, : int(inp.ks[qi])].astype(np.int64),
+                            rd[qi, : int(inp.ks[qi])])
+                for qi in range(nq)]
 
 
 class RingEngine(ShardedEngine):

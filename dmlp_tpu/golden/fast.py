@@ -73,7 +73,13 @@ def knn_golden_fast(inp: KNNInput, margin: int = 64,
         exact = np.einsum("qka,qka->qk", diff, diff)
 
         coarse_cand = np.take_along_axis(coarse, cand, axis=1)
-        err = 256.0 * eps * (qn[:, None] + dn[cand] + 1.0)
+        # The bound must cover the points the coarse pass EXCLUDED (their
+        # coarse value could be understated by up to the rounding error of
+        # the norm+matmul form), and an excluded point's |d|^2 can exceed
+        # every candidate's — so it uses the global max norm, not dn[cand]
+        # (ADVICE r1: the candidate-norm bound did not strictly prove
+        # exactness for adversarial large-norm excluded points).
+        err_q = 256.0 * eps * (qn + (dn.max() if nd else 0.0) + 1.0)
 
         for qi in range(q0, q1):
             row = qi - q0
@@ -84,7 +90,7 @@ def knn_golden_fast(inp: KNNInput, margin: int = 64,
                 kth_exact = np.partition(exact[row], min(k, kcand) - 1)[
                     min(k, kcand) - 1]
                 boundary = coarse_cand[row].max()
-                if not (kth_exact < boundary - err[row].max()):
+                if not (kth_exact < boundary - err_q[row]):
                     results[qi] = _strict_row(inp, qi, data, labels, ids)
                     fallbacks += 1
                     continue

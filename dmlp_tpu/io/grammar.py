@@ -72,10 +72,20 @@ class KNNInput:
         return np.arange(self.params.num_queries, dtype=np.int32)
 
 
+def _strict_int(tok: str) -> int:
+    """int() minus PEP 515 underscores — the reference's stringstream
+    integer extraction rejects "1_0"; so must both parsers (the native C++
+    one already does via its end-of-token check)."""
+    if "_" in tok:
+        raise ValueError(f"invalid integer token {tok!r}")
+    return int(tok)
+
+
 def parse_params(line: str) -> Params:
     """Parse the header line (reference common.cpp:12-15)."""
     toks = line.split()
-    return Params(int(toks[0]), int(toks[1]), int(toks[2]))
+    return Params(_strict_int(toks[0]), _strict_int(toks[1]),
+                  _strict_int(toks[2]))
 
 
 def parse_update(line: str) -> Update:
@@ -131,6 +141,12 @@ def parse_input_text(text: str) -> KNNInput:
         line = lines[1 + i]
         if not line:
             raise ValueError("Line is empty")  # common.cpp:101
+        if "_" in line:
+            # Python's float()/int() accept PEP 515 underscores ("1_0" ->
+            # 10.0) but the reference's stringstream extraction rejects
+            # them; the contract is the reference's (and the native C++
+            # parser matches this).
+            raise ValueError("Line is wrongly formatted")
         toks = line.split()
         labels[i] = int(toks[0])
         data_attrs[i] = [float(t) for t in toks[1 : 1 + na]]
@@ -141,6 +157,8 @@ def parse_input_text(text: str) -> KNNInput:
         line = lines[1 + nd + i]
         if not line or line[0] != "Q":
             raise ValueError("Line is wrongly formatted")  # common.cpp:114
+        if "_" in line:
+            raise ValueError("Line is wrongly formatted")
         toks = line[1:].split()
         ks[i] = int(toks[0])
         query_attrs[i] = [float(t) for t in toks[1 : 1 + na]]

@@ -49,17 +49,24 @@ inline bool at_eol(const Cursor& c) {
     return c.p >= c.end || *c.p == '\n';
 }
 
-// Parse an integer token. Strict like Python's int(): the token must end at
-// whitespace/EOL ("3.5" as a label/k/header value is an error, matching the
-// pure-Python parser's accept/reject behavior).
+// A parsed token must end at whitespace/EOL/EOF — trailing garbage
+// ("1.5abc", "1_0", "0x10") is a format error, exactly like the
+// reference's stringstream extraction followed by a failed next read
+// (common.cpp parsers) and the Python parser's per-token conversion.
+inline bool token_ends(const char* q, const char* end) {
+    return q >= end || *q == ' ' || *q == '\t' || *q == '\r' || *q == '\n';
+}
+
+// Parse an integer token. Strict: the token must end at whitespace/EOL
+// ("3.5" as a label/k/header value is an error, matching the pure-Python
+// parser's accept/reject behavior).
 inline bool parse_long(Cursor& c, long* out) {
     skip_spaces(c);
     if (at_eol(c)) return false;
     char* q;
     long v = strtol(c.p, &q, 10);
     if (q == c.p) return false;
-    if (q < c.end && *q != ' ' && *q != '\t' && *q != '\r' && *q != '\n')
-        return false;
+    if (!token_ends(q, c.end)) return false;
     c.p = q;
     *out = v;
     return true;
@@ -104,6 +111,7 @@ inline bool parse_double(Cursor& c, double* out) {
     }
     bool has_exp = d < c.end && (*d == 'e' || *d == 'E');
     if (digits > 0 && digits <= 15 && frac <= 22 && !has_exp) {
+        if (!token_ends(d, c.end)) return false;  // "1.5abc", "1_0", "0x10"
         double v = static_cast<double>(mant);
         if (frac) v /= kPow10[frac];
         *out = neg ? -v : v;
@@ -113,6 +121,7 @@ inline bool parse_double(Cursor& c, double* out) {
     char* q;
     double v = strtod_l(c.p, &q, c_locale());
     if (q == c.p) return false;
+    if (!token_ends(q, c.end)) return false;
     c.p = q;
     *out = v;
     return true;

@@ -53,3 +53,13 @@ def test_parse_update():
     u = parse_update("7 1.5 2.5 3.5")
     assert u.id == 7
     np.testing.assert_allclose(u.new_attrs, [1.5, 2.5, 3.5])
+
+
+def test_header_underscore_rejected():
+    """Header parity with the native parser: int('1_0') would accept PEP
+    515 underscores the reference's stringstream rejects."""
+    import pytest
+
+    from dmlp_tpu.io.grammar import parse_params
+    with pytest.raises(ValueError):
+        parse_params("1_0 1 1")

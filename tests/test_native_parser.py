@@ -113,3 +113,40 @@ def test_parse_input_dispatches_to_native_above_threshold(monkeypatch):
     inp = parse_input(io.StringIO(text))
     assert calls.get("native")
     assert inp.params.num_data == 50
+
+
+@pytest.mark.parametrize("bad_attr", ["1.5abc", "0x10", "1_0", "1.5_0",
+                                      "2.e", "--3"])
+def test_trailing_garbage_and_underscores_rejected_by_both(bad_attr):
+    """ADVICE r1: the fast double path accepted trailing garbage on the
+    last attribute; both parsers must reject identically (the reference's
+    stringstream extraction would)."""
+    good = "2 1 2\n1 1.0 2.0\n0 3.0 %s\nQ 1 1.0 2.0\n"
+    text = good % bad_attr
+    with pytest.raises(ValueError):
+        parse_input_text(text)
+    if native.native_available():
+        with pytest.raises(ValueError):
+            native.parse_input_text_native(text.encode())
+
+
+@pytest.mark.parametrize("tok", ["2.", ".5", "-2.5", "+3", "inf",
+                                 "1e3", "3"])
+def test_edge_tokens_agree(tok):
+    """Accept/reject AND value parity on edge-case numeric tokens."""
+    text = f"1 1 1\n0 {tok}\nQ 1 1.0\n"
+    try:
+        want = parse_input_text(text)
+        py_ok = True
+    except ValueError:
+        py_ok = False
+    if not native.native_available():
+        pytest.skip("native parser unavailable")
+    try:
+        got = native.parse_input_text_native(text.encode())
+        nat_ok = True
+    except ValueError:
+        nat_ok = False
+    assert py_ok == nat_ok, tok
+    if py_ok:
+        assert want.data_attrs[0, 0] == got.data_attrs[0, 0]
