@@ -75,6 +75,56 @@ def time_baseline_ms(inp, k: int, sample_queries: int = 1024,
     return elapsed * (inp.params.num_queries / qs)
 
 
+def time_device_solve_ms(inp, repeats: int, use_pallas: bool) -> dict:
+    """On-chip solve time alone: arrays pre-staged, chained dispatches,
+    fenced by a dependent scalar readback (block_until_ready is unreliable
+    over tunneled PJRT links). Reported alongside the end-to-end number
+    because on this host link the end-to-end solve is transfer-bound: the
+    decomposition is what shows where engineering effort lands.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from dmlp_tpu.engine.single import round_up
+    from dmlp_tpu.ops.pallas_distance import _tile
+    from dmlp_tpu.ops.topk import streaming_topk
+
+    n, a = inp.data_attrs.shape
+    nq = inp.params.num_queries
+    k = round_up(int(inp.ks.max()) + 8, 8)
+    out = {}
+    selects = ("seg",) if os.environ.get("BENCH_DEVICE_SOLVE_SELECTS",
+                                         "seg") == "seg" else ("seg", "topk")
+    for select in selects:
+        pallas = use_pallas and select == "seg"
+        granule = 1024 if pallas else 128
+        npad = round_up(n, granule)
+        qpad = round_up(nq, 1024)
+        dblock = _tile(npad, 51200, granule)
+        d = jnp.zeros((npad, a), jnp.float32).at[:n].set(
+            jnp.asarray(inp.data_attrs, jnp.float32))
+        lab = jnp.full(npad, -1, jnp.int32).at[:n].set(jnp.asarray(inp.labels))
+        ids = jnp.where(jnp.arange(npad) < n,
+                        jnp.arange(npad, dtype=jnp.int32), -1)
+        q = jnp.zeros((qpad, a), jnp.float32).at[:nq].set(
+            jnp.asarray(inp.query_attrs, jnp.float32))
+        fn = jax.jit(functools.partial(streaming_topk, k=k,
+                                       data_block=dblock, select=select,
+                                       use_pallas=pallas))
+        float(jnp.sum(d))  # fence staging
+        r = fn(q, d, lab, ids)
+        _ = float(r.dists[0, 0])  # compile + fence
+        t0 = time.perf_counter()
+        for _i in range(repeats):
+            r = fn(q + 0.0 * r.dists[0, 0], d, lab, ids)  # chain dependency
+        _ = float(r.dists[0, 0])  # fence
+        out[f"device_solve_ms_{select}"] = round(
+            (time.perf_counter() - t0) / repeats * 1e3, 1)
+    return out
+
+
 def time_engine_ms(inp, mode: str, repeats: int):
     """Median engine.run() wall time, plus a record of which code path
     actually ran (select strategy, pallas on/off, phase breakdown) — the
@@ -127,6 +177,9 @@ def main() -> int:
 
     inp = make_workload(num_data, num_queries, num_attrs, k)
     engine_ms, path = time_engine_ms(inp, mode, repeats)
+    if os.environ.get("BENCH_DEVICE_SOLVE", "1") == "1":
+        path["phases_ms"].update(
+            time_device_solve_ms(inp, 1, path["use_pallas"]))
     baseline_ms = time_baseline_ms(inp, k)
 
     pairs_per_s = num_data * num_queries / (engine_ms / 1e3)
