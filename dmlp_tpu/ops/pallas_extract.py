@@ -1,0 +1,198 @@
+"""Pallas TPU kernel: fused distance + running top-k by iterative extraction.
+
+The round-2 solve wrote every (Q, B) distance tile to HBM (8.4 GB at the
+benchmark shape) and selected from it with segment-min + gather + lax.top_k
+— measured on v5e the selection pipeline costs ~15x the distance matmul
+(tools/profile_amortized.py). This kernel is the VERDICT-prescribed fix:
+selection happens in VMEM while the distance block is still resident, so
+the tile never exists in HBM at all.
+
+Algorithm (replaces the reference's per-rank hot loop + nth_element,
+engine.cpp:233-257, with a threshold-gated extraction):
+
+- Grid (Qb/tq, B/tn); the (tq, kc) running top-k lives in the revisited
+  output block (VMEM-resident across the data-block sweep, flash-attention
+  style accumulator).
+- Per data block: one MXU pass computes the (tq, tn) distance block into a
+  VMEM scratch via the norm expansion |q-d|^2 = |q|^2 + |d|^2 - 2 q.d.
+- A while-loop then extracts candidates: each iteration finds the minimum
+  of each quarter of the block (4 candidates per row per pass), inserts
+  those that beat the row's current k-th best (its threshold T = max of the
+  running list) into the running list, and masks them out of the block.
+  The loop ends when no row improved — for blocks that arrive after the
+  running lists are warm, the expected number of iterations is ~1 + k*tn/N,
+  so almost all blocks cost one scan, not a sort.
+
+Ties are kept by lowest global position (strict `m < T` extraction +
+lowest-lane argmin), i.e. the same semantics as the "topk"/"seg" selects;
+the engines' boundary-overflow detection + host repair applies unchanged.
+
+The kernel requires affine data ids: row j of `d` has global id
+``id_base + j``, rows at positions >= n_real are sentinels (masked to +inf,
+reported as id -1). Both are trace-time constants, which every engine
+staging path satisfies (chunks/shards are contiguous global row ranges).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dmlp_tpu.ops.pallas_distance import _tile
+
+_TQ = 512    # query rows per tile
+_TN = 8192   # data rows per block (4 quarters of 2048 lanes)
+_E = 4       # extraction candidates per loop iteration (quarter minima)
+
+
+def supports(qb: int, b: int, a: int, kc: int) -> bool:
+    """Shapes the kernel can tile: whole quarters (tn % 512), query tiles
+    of 8, kc no wider than one block, and VMEM room for the distance
+    scratch + double-buffered q/d blocks."""
+    if qb % 8 != 0 or b % 512 != 0:
+        return False
+    tn = _tile(b, _TN, 512)
+    tq = _tile(qb, _TQ, 8)
+    if kc > tn or kc > 512:
+        return False
+    vmem = (tq * tn + 2 * (tq + tn) * a + 4 * tq * kc) * 4
+    return vmem <= 64 * 2**20
+
+
+def _kernel(q_ref, d_ref, qn_ref, dn_ref, cd_ref, ci_ref, od_ref, oi_ref,
+            dist_s, *, n_real: int, id_base: int, kc: int, fresh: bool):
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    tq, tn = dist_s.shape
+    tq_kc = (tq, kc)
+
+    # HIGHEST precision: default truncates f32 to bf16 on the MXU (1e-2
+    # relative distance error measured on v5e — breaks neighbor selection).
+    cross = jax.lax.dot_general(
+        q_ref[:], d_ref[:], (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    dist = qn_ref[:] + dn_ref[:] - 2.0 * cross
+    dist = jnp.maximum(dist, 0.0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tq, tn), 1)
+    pos = j * tn + lane
+    dist = jnp.where(pos >= n_real, jnp.inf, dist)
+
+    if fresh:
+        # First block seeds the running list with its first kc columns
+        # (cheaper than extracting kc entries one loop pass at a time).
+        @pl.when(j == 0)
+        def _():
+            od_ref[:] = jax.lax.slice(dist, (0, 0), (tq, kc))
+            kpos = jax.lax.broadcasted_iota(jnp.int32, tq_kc, 1)
+            oi_ref[:] = jnp.where(kpos < n_real, id_base + kpos, -1)
+        dist = jnp.where((j == 0) & (lane < kc), jnp.inf, dist)
+    else:
+        @pl.when(j == 0)
+        def _():
+            od_ref[:] = cd_ref[:]
+            oi_ref[:] = ci_ref[:]
+
+    dist_s[:] = dist
+
+    kiota = jax.lax.broadcasted_iota(jnp.int32, tq_kc, 1)
+    w = tn // _E
+    wlane = jax.lax.broadcasted_iota(jnp.int32, (tq, w), 1)
+
+    def body(state):
+        it, _ = state
+        # Each quarter independently: find its min, insert if it beats the
+        # row's current k-th best, mask it out. All ops are 2D with
+        # lane-aligned static slices — 3D reshapes / lane-offset slices
+        # blow up the Mosaic compile.
+        go = jnp.int32(0)
+        for e in range(_E):
+            qd = dist_s[:, e * w:(e + 1) * w]               # (tq, w)
+            m = jnp.min(qd, axis=1, keepdims=True)          # (tq, 1)
+            am = jnp.min(jnp.where(qd == m, wlane, w), axis=1,
+                         keepdims=True)                     # (tq, 1)
+            rd = od_ref[:]
+            t = jnp.max(rd, axis=1, keepdims=True)          # (tq, 1)
+            better = m < t                                  # (tq, 1)
+            wi = jnp.min(jnp.where(rd == t, kiota, kc), axis=1,
+                         keepdims=True)
+            ins = better & (kiota == wi)
+            od_ref[:] = jnp.where(ins, m, rd)
+            gid = id_base + j * tn + e * w + am
+            oi_ref[:] = jnp.where(ins, gid, oi_ref[:])
+            dist_s[:, e * w:(e + 1) * w] = jnp.where(
+                better & (wlane == am), jnp.inf, qd)
+            go = go + jnp.max(better.astype(jnp.int32))
+        return it + 1, go > 0
+
+    jax.lax.while_loop(
+        lambda s: s[1] & (s[0] <= tn), body, (jnp.int32(0), True))
+
+    # Output blocks map to (i, 0): they stay VMEM-resident across the
+    # data-block sweep and flush once after the last block.
+    del nj
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_real", "id_base", "kc", "interpret"))
+def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
+                 carry_d: jax.Array | None = None,
+                 carry_i: jax.Array | None = None, *, n_real: int,
+                 id_base: int = 0, kc: int, interpret: bool = False):
+    """(queries (Qb, A), data (B, A)) -> (dists (Qb, kc) f32 ascending-ish
+    unsorted, ids (Qb, kc) i32). Rows >= n_real are sentinels; data row j
+    has global id id_base + j. Optional carry (prior running lists, e.g.
+    from a previous chunk) is folded in; without it slots pad (+inf, -1).
+
+    Gate on supports() first. Output lists are NOT sorted; callers sort by
+    the composite key (ops.topk.select_topk) if order matters.
+    """
+    qb, a = q_attrs.shape
+    b = d_attrs.shape[0]
+    assert supports(qb, b, a, kc), f"untileable (qb={qb}, b={b}, kc={kc})"
+    tq = _tile(qb, _TQ, 8)
+    tn = _tile(b, _TN, 512)
+
+    q32 = q_attrs.astype(jnp.float32)
+    d32 = d_attrs.astype(jnp.float32)
+    qn = jnp.sum(q32 * q32, axis=-1, keepdims=True)
+    dn = jnp.sum(d32 * d32, axis=-1)[None, :]
+
+    fresh = carry_d is None
+    if fresh:
+        carry_d = jnp.full((qb, kc), jnp.inf, jnp.float32)
+        carry_i = jnp.full((qb, kc), -1, jnp.int32)
+
+    grid = (qb // tq, b // tn)
+    kern = functools.partial(_kernel, n_real=n_real, id_base=id_base,
+                             kc=kc, fresh=fresh)
+    out_d, out_i = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tq, a), lambda i, j: (i, 0)),
+            pl.BlockSpec((tn, a), lambda i, j: (j, 0)),
+            pl.BlockSpec((tq, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tn), lambda i, j: (0, j)),
+            pl.BlockSpec((tq, kc), lambda i, j: (i, 0)),
+            pl.BlockSpec((tq, kc), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tq, kc), lambda i, j: (i, 0)),
+            pl.BlockSpec((tq, kc), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((qb, kc), jnp.float32),
+            jax.ShapeDtypeStruct((qb, kc), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((tq, tn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=96 * 2**20),
+        interpret=interpret,
+    )(q32, d32, qn, dn, carry_d, carry_i)
+    return out_d, out_i
