@@ -75,6 +75,46 @@ def time_baseline_ms(inp, k: int, sample_queries: int = 1024,
     return elapsed * (inp.params.num_queries / qs)
 
 
+def _time_extract_solve_ms(inp, repeats: int, use_pallas: bool):
+    """Fenced on-chip time of the fused extraction solve (select="extract",
+    ops.pallas_extract): one call over the whole padded dataset — the
+    distance tile never reaches HBM. None when the kernel can't run here."""
+    import jax
+    import jax.numpy as jnp
+
+    from dmlp_tpu.engine.single import round_up
+    from dmlp_tpu.ops.pallas_extract import extract_topk
+    from dmlp_tpu.ops.pallas_extract import supports as extract_supports
+
+    n, a = inp.data_attrs.shape
+    nq = inp.params.num_queries
+    k = round_up(int(inp.ks.max()) + 8, 8)
+    # Whole 8192-row blocks / 512-row query tiles: awkward sizes otherwise
+    # tile degenerately (see config.resolve_granule("extract")).
+    npad = round_up(n, 8192)
+    qpad = round_up(nq, 512)
+    if not (use_pallas and extract_supports(qpad, npad, a, k)):
+        return None
+    d = jnp.zeros((npad, a), jnp.float32).at[:n].set(
+        jnp.asarray(inp.data_attrs, jnp.float32))
+    q = jnp.zeros((qpad, a), jnp.float32).at[:nq].set(
+        jnp.asarray(inp.query_attrs, jnp.float32))
+    float(jnp.sum(d))  # fence staging
+
+    def fn(q_, d_):
+        return extract_topk(q_, d_, n_real=n, kc=k)[0]
+
+    r = fn(q, d)
+    _ = float(r[0, 0])           # compile + fence
+    r = fn(q + 0.0 * r[0, 0], d)
+    _ = float(r[0, 0])           # warm the perturbation chain (see below)
+    t0 = time.perf_counter()
+    for _i in range(repeats):
+        r = fn(q + 0.0 * r[0, 0], d)
+    _ = float(r[0, 0])
+    return round((time.perf_counter() - t0) / repeats * 1e3, 1)
+
+
 def time_device_solve_ms(inp, repeats: int, use_pallas: bool) -> dict:
     """On-chip solve time alone: arrays pre-staged, chained dispatches,
     fenced by a dependent scalar readback (block_until_ready is unreliable
@@ -97,9 +137,14 @@ def time_device_solve_ms(inp, repeats: int, use_pallas: bool) -> dict:
     out = {}
     selects = tuple(
         s for s in (t.strip() for t in os.environ.get(
-            "BENCH_DEVICE_SOLVE_SELECTS", "seg").split(","))
-        if s in ("seg", "topk", "sort"))
+            "BENCH_DEVICE_SOLVE_SELECTS", "extract,seg").split(","))
+        if s in ("extract", "seg", "topk", "sort"))
     for select in selects:
+        if select == "extract":
+            ms = _time_extract_solve_ms(inp, repeats, use_pallas)
+            if ms is not None:
+                out["device_solve_ms_extract"] = ms
+            continue
         pallas = use_pallas and select == "seg"
         granule = 1024 if pallas else 128
         npad = round_up(n, granule)

@@ -95,13 +95,13 @@ def main(argv: Optional[Sequence[str]] = None,
     parser.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16"])
     parser.add_argument("--select", default="auto",
-                        choices=["auto", "sort", "topk", "seg"],
+                        choices=["auto", "sort", "topk", "seg", "extract"],
                         help="device k-selection strategy")
     parser.add_argument("--phase-times", action="store_true",
                         help="per-phase ms breakdown on stderr (extension)")
     parser.add_argument("--pallas", action="store_true",
-                        help="fused Pallas distance+segment-min kernel "
-                             "(implies seg selection on large inputs)")
+                        help="fused Pallas kernels (implies extract "
+                             "selection on large inputs)")
     parser.add_argument("--profile", metavar="DIR", default=None,
                         help="write a jax.profiler trace of the solve to "
                              "DIR (survey §5.1 observability gap)")

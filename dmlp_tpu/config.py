@@ -51,10 +51,14 @@ class EngineConfig:
         to its min and runs top_k on ~(k+16)*128 gathered candidates
         instead of the whole tile — exact by distance, with an in-jit
         fallback to "topk" when segment-min ties make the threshold
-        inconclusive; "auto" = "sort" for small inputs (ties can be
-        adversarial there, cost is negligible), "topk" once the padded
-        dataset exceeds AUTO_SELECT_THRESHOLD rows ("seg" only wins once
-        its reduction is fused into the distance pass — use_pallas).
+        inconclusive; "extract" = fused Pallas distance + in-VMEM
+        iterative-extraction running top-k (ops.pallas_extract) — the
+        distance tile never reaches HBM; exact by distance with the same
+        lowest-position tie behavior (and host repair) as "topk";
+        "auto" = "sort" for small inputs (ties can be adversarial there,
+        cost is negligible), then "extract" with use_pallas (fastest,
+        119 ms vs 231/400 at the benchmark shape on v5e) or "topk"
+        without, once the padded dataset exceeds AUTO_SELECT_THRESHOLD.
       debug: human-readable output instead of checksums — the -DDEBUG
         build of the reference (common.cpp:72-78).
       use_pallas: use the fused Pallas distance kernel where available.
@@ -78,7 +82,7 @@ class EngineConfig:
             raise ValueError(f"unknown mode {self.mode!r}")
         if self.dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unsupported dtype {self.dtype!r}")
-        if self.select not in ("auto", "sort", "topk", "seg"):
+        if self.select not in ("auto", "sort", "topk", "seg", "extract"):
             raise ValueError(f"unknown select {self.select!r}")
         if (self.data_block is not None and self.data_block <= 0) \
                 or self.query_block <= 0:
@@ -92,20 +96,31 @@ class EngineConfig:
             return self.select
         if padded_rows <= self.AUTO_SELECT_THRESHOLD:
             return "sort"
-        # Measured on TPU v5e: plain XLA "seg" re-reads the distance tile
-        # for its segment-min pass and lands at ~the same cost as "topk";
-        # the fused Pallas producer makes "seg" the winner.
-        return "seg" if self.use_pallas else "topk"
+        # Measured on TPU v5e (204800x10240x64, k=40): "extract" (fused
+        # distance + in-VMEM iterative extraction, ops.pallas_extract)
+        # 119 ms; "seg" with the fused producer 231 ms; XLA "topk" ~400 ms.
+        # The engines gate "extract" on pallas_extract.supports() per shape
+        # and fall back to "seg"/"topk" when it cannot tile.
+        return "extract" if self.use_pallas else "topk"
 
     def resolve_granule(self, select: str) -> int:
         """data_block granularity: whole 1024-column Pallas tiles for the
-        fused seg producer, whole 128-column segments for XLA seg, 8 rows
-        otherwise (must stay in sync with ops.pallas_distance.supports)."""
+        fused seg producer, whole 128-column segments for XLA seg, whole
+        512-row extraction blocks for "extract", 8 rows otherwise (must
+        stay in sync with ops.pallas_distance/pallas_extract supports)."""
         if select == "seg":
             return 1024 if self.use_pallas else 128
+        if select == "extract":
+            # Full extraction blocks: a merely-512-divisible size can have
+            # no large divisor (200000 pads to 512*391, 391 = 17*23, so the
+            # largest tileable block is 512 — measured 4x slower than the
+            # 8192 blocks a 512*392 pad allows). Padding to whole blocks
+            # wastes <= 8191 sentinel rows (~2% at the benchmark shape) and
+            # keeps the block size maximal.
+            return 8192
         return 8
 
     def resolve_data_block(self, select: str) -> int:
         if self.data_block is not None:
             return self.data_block
-        return 65536 if select in ("topk", "seg") else 2048
+        return 65536 if select in ("topk", "seg", "extract") else 2048

@@ -43,6 +43,21 @@ def round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def plan_chunks(n: int, granule: int, target: int | None) -> Tuple[int, int, int]:
+    """Chunked-staging plan shared by the pipelined and extract drivers:
+    (npad, nchunks, chunk_rows) — ~``target``-row chunks (default 51200,
+    measured best on the tunneled v5e link: big enough that per-chunk merge
+    work stays negligible, small enough that the first fold starts while
+    later chunks are still in flight) of whole ``granule`` blocks covering
+    ``n``. Large granules can make the final chunk all padding; drivers
+    skip staging it."""
+    npad = round_up(max(n, 1), granule)
+    t = round_up(target or 51200, granule)
+    nchunks = max(1, -(-npad // t))
+    chunk_rows = round_up(-(-npad // nchunks), granule)
+    return npad, nchunks, chunk_rows
+
+
 def fit_blocks(n: int, target_block: int, granule: int = 8) -> int:
     """A data_block (multiple of ``granule``, <= ~target_block) whose
     round_up padding wastes < granule * nblocks rows of n.
@@ -64,7 +79,7 @@ def resolve_kcap(cfg: EngineConfig, kmax: int, select: str, cap: int) -> int:
     with margin 0: the tie-overflow detector compares the k-th and last
     candidate, which coincide without slack (degenerate all-repair)."""
     extra = cfg.margin if cfg.exact else 0
-    if select in ("topk", "seg"):
+    if select in ("topk", "seg", "extract"):
         extra = max(extra, 8)
     return max(min(round_up(kmax + extra, 8), cap), kmax)
 
@@ -119,6 +134,17 @@ def _device_flags(dists, ks):
     kth = jnp.take_along_axis(
         dists, jnp.clip(ks[:, None] - 1, 0, kcap - 1), axis=1)[:, 0]
     return jnp.isfinite(last) & (last == kth)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _extract_finalize(od, oi, glabels, *, k):
+    """Extraction-kernel epilogue: gather labels from global ids and sort
+    the (unordered) running lists into the golden selection order
+    (dist asc, label desc, id desc) — a tiny (Q, K) composite sort."""
+    from dmlp_tpu.ops.topk import select_topk
+    n = glabels.shape[0]
+    labels = jnp.where(oi >= 0, glabels[jnp.clip(oi, 0, max(n - 1, 0))], -1)
+    return select_topk(od, labels, oi, k)
 
 
 @functools.partial(jax.jit,
@@ -212,17 +238,14 @@ class SingleChipEngine:
         na = inp.params.num_attrs
         nq = inp.params.num_queries
         select = cfg.resolve_select(round_up(max(n, 1), 8))
+        if select == "extract":
+            # only reached when the extraction kernel can't tile this shape
+            select = "seg" if cfg.use_pallas else "topk"
         self._last_select = select
         granule = cfg.resolve_granule(select)
 
         t0 = _time.perf_counter()
-        npad = round_up(max(n, 1), granule)
-        # ~50k-row chunks measured best on the tunneled v5e link: big enough
-        # that per-chunk merge work stays negligible, small enough that the
-        # first fold starts while later chunks are still in flight.
-        target = cfg.data_block or 51200
-        nchunks = max(1, -(-npad // round_up(target, granule)))
-        chunk_rows = round_up(-(-npad // nchunks), granule)
+        npad, nchunks, chunk_rows = plan_chunks(n, granule, cfg.data_block)
 
         # Query padding: multiples of 1024 keep the fused Pallas tiling
         # eligible (ops.pallas_distance.supports); 8 otherwise.
@@ -270,11 +293,75 @@ class SingleChipEngine:
         return TopK(*(jnp.concatenate(parts) for parts in
                       zip(*carries))), qpad
 
+    def _solve_extract(self, inp: KNNInput) -> Tuple[TopK, int] | None:
+        """Chunked staging + the fused extraction kernel (select="extract").
+
+        Each ~50k-row chunk is staged asynchronously and folded into the
+        running (Q, K) lists by ops.pallas_extract.extract_topk — the
+        distance tile lives only in VMEM, so HBM holds just the chunk, the
+        queries, and the lists. Chunk row ranges are contiguous, giving the
+        kernel its trace-time-affine ids (id_base = chunk start). Returns
+        None when the kernel can't tile this shape (caller falls back).
+        """
+        import time as _time
+
+        from dmlp_tpu.ops.pallas_distance import native_pallas_backend
+        from dmlp_tpu.ops.pallas_extract import extract_topk
+        from dmlp_tpu.ops.pallas_extract import supports as extract_supports
+
+        cfg = self.config
+        n = inp.params.num_data
+        na = inp.params.num_attrs
+        nq = inp.params.num_queries
+        if n == 0 or nq == 0:
+            return None
+
+        granule = cfg.resolve_granule("extract")
+        t0 = _time.perf_counter()
+        npad, nchunks, chunk_rows = plan_chunks(n, granule, cfg.data_block)
+        # Queries pad to a whole 512-row tile for the same reason data pads
+        # to whole 8192-row blocks: an awkward qb (e.g. 8 * prime) would
+        # force a degenerate 8-row query tile.
+        qpad = round_up(nq, 512)
+        kmax = int(inp.ks.max())
+        k = resolve_kcap(cfg, kmax, "extract", nchunks * chunk_rows)
+        if not extract_supports(qpad, chunk_rows, na, k):
+            return None
+        interpret = not native_pallas_backend()
+        self._last_select = "extract"
+
+        q_attrs = np.zeros((qpad, na), np.float32)
+        q_attrs[:nq] = inp.query_attrs
+        q_dev = jnp.asarray(q_attrs, self._dtype)
+        src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
+        od = oi = None
+        for c in range(nchunks):
+            lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
+            if lo >= n:
+                break  # whole-block padding can leave an empty last chunk
+            a = np.zeros((chunk_rows, na), np.float32)
+            if hi > lo:
+                a[:hi - lo] = src_attrs[lo:hi]
+            da = jnp.asarray(a, self._dtype)
+            od, oi, _iters = extract_topk(
+                q_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=k,
+                interpret=interpret)
+        self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
+
+        top = _extract_finalize(od, oi, jnp.asarray(inp.labels), k=k)
+        return top, qpad
+
     def _solve(self, inp: KNNInput) -> Tuple[TopK, int]:
         select = self.config.resolve_select(
             round_up(max(inp.params.num_data, 1), 8))
         if select == "sort":
             return self._solve_scan(inp)
+        if select == "extract":
+            out = self._solve_extract(inp)
+            if out is not None:
+                return out
+            # shape untileable for the extraction kernel — fall through to
+            # the chunk-fold driver on the best remaining path
         return self._solve_pipelined(inp)
 
     def candidates(self, inp: KNNInput) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -307,7 +394,7 @@ class SingleChipEngine:
         kcap = top.dists.shape[1]
 
         flags_dev = None
-        if self._last_select in ("topk", "seg") and kcap < n:
+        if self._last_select in ("topk", "seg", "extract") and kcap < n:
             ks_pad = np.ones(qpad, np.int32)
             ks_pad[:nq] = inp.ks
             flags_dev = _device_flags(top.dists, jnp.asarray(ks_pad))

@@ -22,6 +22,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 SEG = 128  # candidate-segment width = one TPU lane row
 
@@ -117,6 +118,9 @@ def fused_dist_segmin(q_attrs: jax.Array, d_attrs: jax.Array,
             jax.ShapeDtypeStruct((qb, b), jnp.float32),
             jax.ShapeDtypeStruct((b // SEG, qb), jnp.float32),
         ],
+        # HIGHEST-precision dot needs headroom past the default 16M scoped
+        # limit at the full (1024, 1024) tile.
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=32 * 2**20),
         interpret=interpret,
     )(q32, d32, qn, dn, ids2)
     return dist, segmin_t.T

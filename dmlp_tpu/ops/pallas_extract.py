@@ -64,7 +64,8 @@ def supports(qb: int, b: int, a: int, kc: int) -> bool:
 
 
 def _kernel(q_ref, d_ref, qn_ref, dn_ref, cd_ref, ci_ref, od_ref, oi_ref,
-            dist_s, *, n_real: int, id_base: int, kc: int, fresh: bool):
+            it_ref, dist_s, *, n_real: int, id_base: int, kc: int,
+            fresh: bool, ne: int, unroll: int = 1):
     j = pl.program_id(1)
     nj = pl.num_programs(1)
     tq, tn = dist_s.shape
@@ -100,17 +101,16 @@ def _kernel(q_ref, d_ref, qn_ref, dn_ref, cd_ref, ci_ref, od_ref, oi_ref,
     dist_s[:] = dist
 
     kiota = jax.lax.broadcasted_iota(jnp.int32, tq_kc, 1)
-    w = tn // _E
+    w = tn // ne
     wlane = jax.lax.broadcasted_iota(jnp.int32, (tq, w), 1)
 
-    def body(state):
-        it, _ = state
+    def round_():
         # Each quarter independently: find its min, insert if it beats the
         # row's current k-th best, mask it out. All ops are 2D with
         # lane-aligned static slices — 3D reshapes / lane-offset slices
         # blow up the Mosaic compile.
         go = jnp.int32(0)
-        for e in range(_E):
+        for e in range(ne):
             qd = dist_s[:, e * w:(e + 1) * w]               # (tq, w)
             m = jnp.min(qd, axis=1, keepdims=True)          # (tq, 1)
             am = jnp.min(jnp.where(qd == m, wlane, w), axis=1,
@@ -127,10 +127,21 @@ def _kernel(q_ref, d_ref, qn_ref, dn_ref, cd_ref, ci_ref, od_ref, oi_ref,
             dist_s[:, e * w:(e + 1) * w] = jnp.where(
                 better & (wlane == am), jnp.inf, qd)
             go = go + jnp.max(better.astype(jnp.int32))
+        return go
+
+    def body(state):
+        it, _ = state
+        # `unroll` extraction rounds per loop-condition sync. Correctness
+        # needs only the LAST round's found-any flag: if that round found
+        # nothing, no remaining candidate beats any row's threshold.
+        for _u in range(unroll - 1):
+            round_()
+        go = round_()
         return it + 1, go > 0
 
-    jax.lax.while_loop(
+    iters, _ = jax.lax.while_loop(
         lambda s: s[1] & (s[0] <= tn), body, (jnp.int32(0), True))
+    it_ref[pl.program_id(0), j] = iters
 
     # Output blocks map to (i, 0): they stay VMEM-resident across the
     # data-block sweep and flush once after the last block.
@@ -138,24 +149,34 @@ def _kernel(q_ref, d_ref, qn_ref, dn_ref, cd_ref, ci_ref, od_ref, oi_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_real", "id_base", "kc", "interpret"))
+    jax.jit, static_argnames=("n_real", "id_base", "kc", "interpret",
+                              "tile_q", "tile_n", "ne", "unroll"))
 def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
                  carry_d: jax.Array | None = None,
                  carry_i: jax.Array | None = None, *, n_real: int,
-                 id_base: int = 0, kc: int, interpret: bool = False):
+                 id_base: int = 0, kc: int, interpret: bool = False,
+                 tile_q: int = _TQ, tile_n: int = _TN, ne: int = _E,
+                 unroll: int = 1):
     """(queries (Qb, A), data (B, A)) -> (dists (Qb, kc) f32 ascending-ish
-    unsorted, ids (Qb, kc) i32). Rows >= n_real are sentinels; data row j
-    has global id id_base + j. Optional carry (prior running lists, e.g.
-    from a previous chunk) is folded in; without it slots pad (+inf, -1).
+    unsorted, ids (Qb, kc) i32, iters (Qb/tq, B/tn) i32 loop counts).
+    Rows >= n_real are sentinels; data row j has global id id_base + j.
+    Optional carry (prior running lists, e.g. from a previous chunk) is
+    folded in; without it slots pad (+inf, -1).
 
     Gate on supports() first. Output lists are NOT sorted; callers sort by
     the composite key (ops.topk.select_topk) if order matters.
     """
     qb, a = q_attrs.shape
     b = d_attrs.shape[0]
-    assert supports(qb, b, a, kc), f"untileable (qb={qb}, b={b}, kc={kc})"
-    tq = _tile(qb, _TQ, 8)
-    tn = _tile(b, _TN, 512)
+    tq = _tile(qb, tile_q, 8)
+    tn = _tile(b, tile_n, 128 * ne)
+    # Validate the ACTUAL tiling (supports() only covers the defaults):
+    # the fresh-seed slice and quarter layout need kc <= tn, and the
+    # distance scratch + double-buffered blocks must fit VMEM.
+    vmem = (tq * tn + 2 * (tq + tn) * a + 4 * tq * kc) * 4
+    assert (qb % 8 == 0 and b % (128 * ne) == 0 and kc <= tn
+            and kc <= 512 and vmem <= 64 * 2**20), \
+        f"untileable (qb={qb}, b={b}, kc={kc}, tq={tq}, tn={tn}, ne={ne})"
 
     q32 = q_attrs.astype(jnp.float32)
     d32 = d_attrs.astype(jnp.float32)
@@ -169,8 +190,8 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
 
     grid = (qb // tq, b // tn)
     kern = functools.partial(_kernel, n_real=n_real, id_base=id_base,
-                             kc=kc, fresh=fresh)
-    out_d, out_i = pl.pallas_call(
+                             kc=kc, fresh=fresh, ne=ne, unroll=unroll)
+    out_d, out_i, out_iters = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
@@ -184,15 +205,22 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
         out_specs=[
             pl.BlockSpec((tq, kc), lambda i, j: (i, 0)),
             pl.BlockSpec((tq, kc), lambda i, j: (i, 0)),
+            pl.BlockSpec((qb // tq, b // tn), lambda i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((qb, kc), jnp.float32),
             jax.ShapeDtypeStruct((qb, kc), jnp.int32),
+            jax.ShapeDtypeStruct((qb // tq, b // tn), jnp.int32),
         ],
         scratch_shapes=[pltpu.VMEM((tq, tn), jnp.float32)],
+        # Both dims "arbitrary": the iters diagnostic block is shared
+        # across query tiles (constant index map), so a megacore part
+        # parallelizing dim 0 would give each core a private copy whose
+        # final flushes clobber each other.
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+            dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=96 * 2**20),
         interpret=interpret,
     )(q32, d32, qn, dn, carry_d, carry_i)
-    return out_d, out_i
+    return out_d, out_i, out_iters

@@ -101,6 +101,12 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
     nblocks = n // data_block
     qb = query_attrs.shape[0]
 
+    if select == "extract":
+        # The extraction kernel needs trace-time-affine ids (engine.single
+        # drives it directly); inside this generic streaming fold the ids
+        # are arbitrary arrays, so fall back to the best array-ids path.
+        select = "seg" if use_pallas else "topk"
+
     blocks = (data_attrs.reshape(nblocks, data_block, -1),
               data_labels.reshape(nblocks, data_block),
               data_ids.reshape(nblocks, data_block))
