@@ -103,6 +103,18 @@ class EngineConfig:
         # and fall back to "seg"/"topk" when it cannot tile.
         return "extract" if self.use_pallas else "topk"
 
+    def resolve_streaming_select(self, padded_rows: int) -> str:
+        """Like resolve_select, for paths that fold blocks with arbitrary
+        id arrays (the mesh engines' shard_map programs, the chunk-fold
+        driver): the extraction kernel needs trace-time-affine ids, so
+        "extract" maps to the best array-ids strategy there. Engines must
+        record THIS value as _last_select — gating the tie repair on a
+        nominal "extract" would silently skip it."""
+        select = self.resolve_select(padded_rows)
+        if select == "extract":
+            return "seg" if self.use_pallas else "topk"
+        return select
+
     def resolve_granule(self, select: str) -> int:
         """data_block granularity: whole 1024-column Pallas tiles for the
         fused seg producer, whole 128-column segments for XLA seg, whole

@@ -101,13 +101,7 @@ class ShardedEngine:
         n = inp.params.num_data
         r = self.mesh.devices.shape[0]
         shard_rows_est = round_up(max(-(-n // r), 1), 8)
-        select = cfg.resolve_select(shard_rows_est)
-        if select == "extract":
-            # The extraction kernel needs trace-time-affine ids; inside
-            # shard_map ids are arrays, so the mesh engines use the best
-            # array-ids path (and run()'s tie repair gates on the REAL
-            # select — "extract" here would silently skip it).
-            select = "seg" if cfg.use_pallas else "topk"
+        select = cfg.resolve_streaming_select(shard_rows_est)
         if cfg.data_block is not None:
             data_block = min(cfg.data_block, shard_rows_est)
         else:
@@ -154,9 +148,7 @@ class ShardedEngine:
         cfg = self.config
         r = self.mesh.devices.shape[0]
         shard_rows = d_attrs.shape[0] // r
-        select = cfg.resolve_select(shard_rows)
-        if select == "extract":
-            select = "seg" if cfg.use_pallas else "topk"  # see candidates()
+        select = cfg.resolve_streaming_select(shard_rows)
         granule = cfg.resolve_granule(select)
         # _tile snaps to the largest granule-multiple divisor of shard_rows
         # (streaming_topk scans whole blocks, so the block must divide).
@@ -262,9 +254,7 @@ class ShardedEngine:
         n = inp.params.num_data
         r, c = self.mesh.devices.shape
         shard_rows_est = round_up(max(-(-n // r), 1), 8)
-        select = cfg.resolve_select(shard_rows_est)
-        if select == "extract":
-            select = "seg" if cfg.use_pallas else "topk"  # see candidates()
+        select = cfg.resolve_streaming_select(shard_rows_est)
         if cfg.data_block is not None:
             data_block = min(cfg.data_block, shard_rows_est)
         else:

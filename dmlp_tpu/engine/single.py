@@ -237,10 +237,9 @@ class SingleChipEngine:
         n = inp.params.num_data
         na = inp.params.num_attrs
         nq = inp.params.num_queries
-        select = cfg.resolve_select(round_up(max(n, 1), 8))
-        if select == "extract":
-            # only reached when the extraction kernel can't tile this shape
-            select = "seg" if cfg.use_pallas else "topk"
+        # resolve_streaming_select: only reached when the extraction kernel
+        # can't tile this shape (or select != extract in the first place)
+        select = cfg.resolve_streaming_select(round_up(max(n, 1), 8))
         self._last_select = select
         granule = cfg.resolve_granule(select)
 

@@ -63,13 +63,17 @@ def supports(qb: int, b: int, a: int, kc: int) -> bool:
     return vmem <= 64 * 2**20
 
 
-def _kernel(q_ref, d_ref, qn_ref, dn_ref, cd_ref, ci_ref, od_ref, oi_ref,
-            it_ref, dist_s, *, n_real: int, id_base: int, kc: int,
-            fresh: bool, ne: int, unroll: int = 1):
+def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, cd_ref, ci_ref, od_ref,
+            oi_ref, it_ref, dist_s, *, kc: int, fresh: bool, ne: int,
+            unroll: int = 1):
     j = pl.program_id(1)
     nj = pl.num_programs(1)
     tq, tn = dist_s.shape
     tq_kc = (tq, kc)
+    # Runtime scalars from SMEM (static args here would recompile the
+    # Mosaic kernel once per chunk — id_base differs every chunk).
+    n_real = sc_ref[0, 0]
+    id_base = sc_ref[0, 1]
 
     # HIGHEST precision: default truncates f32 to bf16 on the MXU (1e-2
     # relative distance error measured on v5e — breaks neighbor selection).
@@ -141,7 +145,15 @@ def _kernel(q_ref, d_ref, qn_ref, dn_ref, cd_ref, ci_ref, od_ref, oi_ref,
 
     iters, _ = jax.lax.while_loop(
         lambda s: s[1] & (s[0] <= tn), body, (jnp.int32(0), True))
-    it_ref[pl.program_id(0), j] = iters
+    # Diagnostic loop counts: lane j of this tile's block (row 0 is read
+    # back; an iota-select avoids dynamic-lane scalar stores).
+    njs = it_ref.shape[1]
+    ji = jax.lax.broadcasted_iota(jnp.int32, (tq, njs), 1)
+
+    @pl.when(j == 0)
+    def _():
+        it_ref[:] = jnp.zeros((tq, njs), jnp.int32)
+    it_ref[:] = jnp.where(ji == j, iters, it_ref[:])
 
     # Output blocks map to (i, 0): they stay VMEM-resident across the
     # data-block sweep and flush once after the last block.
@@ -149,12 +161,12 @@ def _kernel(q_ref, d_ref, qn_ref, dn_ref, cd_ref, ci_ref, od_ref, oi_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_real", "id_base", "kc", "interpret",
-                              "tile_q", "tile_n", "ne", "unroll"))
+    jax.jit, static_argnames=("kc", "interpret", "tile_q", "tile_n", "ne",
+                              "unroll"))
 def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
                  carry_d: jax.Array | None = None,
-                 carry_i: jax.Array | None = None, *, n_real: int,
-                 id_base: int = 0, kc: int, interpret: bool = False,
+                 carry_i: jax.Array | None = None, *, n_real,
+                 id_base=0, kc: int, interpret: bool = False,
                  tile_q: int = _TQ, tile_n: int = _TN, ne: int = _E,
                  unroll: int = 1):
     """(queries (Qb, A), data (B, A)) -> (dists (Qb, kc) f32 ascending-ish
@@ -188,13 +200,16 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
         carry_d = jnp.full((qb, kc), jnp.inf, jnp.float32)
         carry_i = jnp.full((qb, kc), -1, jnp.int32)
 
+    scalars = jnp.asarray([[n_real, id_base]], jnp.int32)     # (1, 2) SMEM
     grid = (qb // tq, b // tn)
-    kern = functools.partial(_kernel, n_real=n_real, id_base=id_base,
-                             kc=kc, fresh=fresh, ne=ne, unroll=unroll)
+    kern = functools.partial(_kernel, kc=kc, fresh=fresh, ne=ne,
+                             unroll=unroll)
     out_d, out_i, out_iters = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
+            pl.BlockSpec((1, 2), lambda i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
             pl.BlockSpec((tq, a), lambda i, j: (i, 0)),
             pl.BlockSpec((tn, a), lambda i, j: (j, 0)),
             pl.BlockSpec((tq, 1), lambda i, j: (i, 0)),
@@ -205,22 +220,20 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
         out_specs=[
             pl.BlockSpec((tq, kc), lambda i, j: (i, 0)),
             pl.BlockSpec((tq, kc), lambda i, j: (i, 0)),
-            pl.BlockSpec((qb // tq, b // tn), lambda i, j: (0, 0),
-                         memory_space=pltpu.SMEM),
+            # One iters block per query tile (row 0 carries the counts)
+            # keeps dim 0 safely "parallel" — a single shared block would
+            # be clobbered across megacore cores.
+            pl.BlockSpec((tq, b // tn), lambda i, j: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((qb, kc), jnp.float32),
             jax.ShapeDtypeStruct((qb, kc), jnp.int32),
-            jax.ShapeDtypeStruct((qb // tq, b // tn), jnp.int32),
+            jax.ShapeDtypeStruct((qb, b // tn), jnp.int32),
         ],
         scratch_shapes=[pltpu.VMEM((tq, tn), jnp.float32)],
-        # Both dims "arbitrary": the iters diagnostic block is shared
-        # across query tiles (constant index map), so a megacore part
-        # parallelizing dim 0 would give each core a private copy whose
-        # final flushes clobber each other.
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary"),
+            dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=96 * 2**20),
         interpret=interpret,
-    )(q32, d32, qn, dn, carry_d, carry_i)
-    return out_d, out_i, out_iters
+    )(scalars, q32, d32, qn, dn, carry_d, carry_i)
+    return out_d, out_i, out_iters[::tq]

@@ -134,13 +134,19 @@ def test_engine_extract_duplicate_ties_fast_mode():
 
 
 def test_engine_extract_unsupported_shape_falls_back():
-    # 4 attrs x 20 rows: fine; but a 2-query input pads to 8 queries and
-    # 512 data rows — supported. Force unsupported via huge kc: margin
-    # pushes kcap past the 512 cap? Use a tiny dataset with maxK so big
-    # the kcap cap binds and supports() still passes — instead exercise
-    # the explicit fallback: data too small for AUTO (sort path) is
-    # covered elsewhere, so here just check run() still matches golden
-    # when select="extract" is forced on an odd shape.
+    # kcap = round_up(600 + 16, 8) = 616 > the kernel's 512 candidate cap,
+    # so _solve_extract must return None and the chunk-fold driver must
+    # take over on the remapped select — results still golden.
+    text = generate_input_text(900, 6, 3, 0, 1, 600, 600, 3, seed=5)
+    inp = parse_input_text(text)
+    eng = _engine()
+    got = eng.run(inp)
+    assert eng._last_select != "extract"
+    assert_same_results(got, knn_golden(inp))
+
+
+def test_engine_extract_forced_on_small_shape():
+    # Explicit --select extract on a supported small shape keeps parity.
     text = generate_input_text(300, 10, 3, 0, 1, 1, 37, 3, seed=5)
     inp = parse_input_text(text)
     eng = _engine()
