@@ -39,7 +39,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--mesh", default=None, help="R,C (data x query axes); "
                    "default auto-factorizes all devices")
     p.add_argument("--select", default="auto",
-                   choices=["auto", "sort", "topk", "seg"])
+                   choices=["auto", "sort", "topk", "seg", "extract"])
     p.add_argument("--data-block", type=int, default=None)
     p.add_argument("--pallas", action="store_true")
     p.add_argument("--debug", action="store_true")

@@ -73,9 +73,14 @@ class KNNInput:
 
 
 def _strict_int(tok: str) -> int:
-    """int() minus PEP 515 underscores — the reference's stringstream
-    integer extraction rejects "1_0"; so must both parsers (the native C++
-    one already does via its end-of-token check)."""
+    """int() minus PEP 515 underscores. Python would read "1_0" as 10; the
+    reference's unchecked ``ss >> val`` stops at the underscore and
+    silently misparses (subsequent extractions fail, leaving stale
+    values — common.cpp:17-29 never checks the stream). Neither behavior
+    is worth copying: both parsers here (this one and the native C++
+    tokenizer's end-of-token check) reject such tokens loudly instead.
+    Generator-produced inputs never contain them, so this is a strictness
+    choice, not a contract requirement."""
     if "_" in tok:
         raise ValueError(f"invalid integer token {tok!r}")
     return int(tok)
@@ -143,9 +148,10 @@ def parse_input_text(text: str) -> KNNInput:
             raise ValueError("Line is empty")  # common.cpp:101
         if "_" in line:
             # Python's float()/int() accept PEP 515 underscores ("1_0" ->
-            # 10.0) but the reference's stringstream extraction rejects
-            # them; the contract is the reference's (and the native C++
-            # parser matches this).
+            # 10.0); the reference's unchecked stringstream extraction
+            # silently misparses them instead (see _strict_int). Reject
+            # loudly — matching the native C++ parser, not the reference's
+            # silent-garbage behavior.
             raise ValueError("Line is wrongly formatted")
         toks = line.split()
         labels[i] = int(toks[0])

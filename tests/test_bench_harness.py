@@ -146,3 +146,33 @@ def test_run_config_engine_error_is_isolated(tiny_cfg, tmp_path):
     assert res.get("error")
     assert not res["checksums_match"]
     assert "ERROR" in buf.getvalue()
+
+
+def test_run_config_multiproc_cluster(monkeypatch, tmp_path):
+    """Config 5 analog at tiny scale: a real 2-process Gloo cluster under
+    the harness kill timeout, proc-0 stdout diffed against the oracle —
+    the run_bench.sh multi-node flow end-to-end (VERDICT r2 item 4)."""
+    cfg = BenchConfig(5, 180, 16, 4, 0.0, 10.0, 1, 8, 4, 7, "mp.in",
+                      mode="sharded", procs=2, virtual_devices=4)
+    monkeypatch.setitem(
+        __import__("dmlp_tpu.bench.configs",
+                   fromlist=["BENCH_CONFIGS"]).BENCH_CONFIGS, 5, cfg)
+    buf = io.StringIO()
+    res = run_config(5, base_dir=str(tmp_path), out=buf, timeout_s=240,
+                     env=_scrubbed_env())
+    assert res["checksums_match"], buf.getvalue()
+    assert "Config 5: checksums PASS" in buf.getvalue()
+
+
+def test_run_engine_passes_pallas_and_select(tmp_path):
+    """use_pallas/select must reach the engine argv (VERDICT r2 item 3:
+    the r2 harness always benched the default path)."""
+    from dmlp_tpu.bench.harness import run_engine
+
+    cfg = BenchConfig(1, 128, 8, 3, 0.0, 10.0, 1, 6, 4, 7, "ps.in",
+                      use_pallas=True, select="seg")
+    inp = ensure_input(cfg, str(tmp_path / "inputs"))
+    out_p, err_p = run_engine(cfg, inp, str(tmp_path), env=_scrubbed_env(),
+                              timeout_s=240)
+    with open(out_p) as f:
+        assert "checksum:" in f.read()

@@ -1,0 +1,38 @@
+"""Run the full bench harness (configs 1-5) and record HARNESS_r{N}.json.
+
+The committed artifact for VERDICT r2 item 3: every config's checksum
+PASS/FAIL + oracle/engine times, with the engine path flags the config
+pinned (use_pallas/select), run on whatever platform the environment
+provides (real TPU for the single-chip configs under axon; virtual CPU
+mesh for the mesh/multi-process configs).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from dmlp_tpu.bench.configs import BENCH_CONFIGS
+from dmlp_tpu.bench.harness import run_config
+
+
+def main() -> int:
+    round_tag = sys.argv[1] if len(sys.argv) > 1 else "r03"
+    results = []
+    for cid, cfg in sorted(BENCH_CONFIGS.items()):
+        res = run_config(cid, base_dir=".", timeout_s=580.0)
+        res.update({"mode": cfg.mode, "use_pallas": cfg.use_pallas,
+                    "select": cfg.select, "procs": cfg.procs,
+                    "virtual_devices": cfg.virtual_devices,
+                    "shape": [cfg.num_data, cfg.num_queries, cfg.num_attrs]})
+        results.append(res)
+        print(json.dumps(res), flush=True)
+    ok = all(r["checksums_match"] for r in results)
+    with open(f"HARNESS_{round_tag}.json", "w") as f:
+        json.dump({"all_pass": ok, "configs": results}, f, indent=1)
+    print(f"HARNESS_{round_tag}.json written, all_pass={ok}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
