@@ -108,6 +108,20 @@ class EngineTimeout(RuntimeError):
     """The engine subprocess exceeded the harness timeout and was killed."""
 
 
+def _engine_flags(cfg: BenchConfig, effective_mode: str) -> list:
+    """Engine path flags shared by the single- and multi-process runners —
+    one place to wire new BenchConfig knobs (the r2 harness silently
+    benched the default path because these never reached the argv)."""
+    argv = []
+    if cfg.mesh_shape is not None and effective_mode != "single":
+        argv += ["--mesh", f"{cfg.mesh_shape[0]},{cfg.mesh_shape[1]}"]
+    if cfg.use_pallas:
+        argv.append("--pallas")
+    if cfg.select != "auto":
+        argv += ["--select", cfg.select]
+    return argv
+
+
 def _config_env(cfg: BenchConfig, env: Optional[dict]) -> Optional[dict]:
     """Subprocess environment for a config: ``virtual_devices`` forces the
     CPU platform with that many virtual devices (and strips the axon TPU
@@ -115,7 +129,14 @@ def _config_env(cfg: BenchConfig, env: Optional[dict]) -> Optional[dict]:
     if not cfg.virtual_devices:
         return env
     e = dict(env if env is not None else os.environ)
-    e.pop("PYTHONPATH", None)
+    # Strip only the axon sitecustomize entry — dmlp_tpu itself may be
+    # importable solely via PYTHONPATH (it is not pip-installed here).
+    parts = [p for p in e.get("PYTHONPATH", "").split(os.pathsep)
+             if p and ".axon_site" not in p]
+    if parts:
+        e["PYTHONPATH"] = os.pathsep.join(parts)
+    else:
+        e.pop("PYTHONPATH", None)
     e["JAX_PLATFORMS"] = "cpu"
     e["PALLAS_AXON_POOL_IPS"] = ""
     e["XLA_FLAGS"] = (
@@ -142,12 +163,7 @@ def run_engine(cfg: BenchConfig, input_path: str, outputs_dir: str,
     import sys
 
     argv = [sys.executable, "-m", "dmlp_tpu", "--mode", mode or cfg.mode]
-    if cfg.mesh_shape is not None and (mode or cfg.mode) != "single":
-        argv += ["--mesh", f"{cfg.mesh_shape[0]},{cfg.mesh_shape[1]}"]
-    if cfg.use_pallas:
-        argv.append("--pallas")
-    if cfg.select != "auto":
-        argv += ["--select", cfg.select]
+    argv += _engine_flags(cfg, mode or cfg.mode)
     if fast:
         argv.append("--fast")
     if warmup:
@@ -201,12 +217,7 @@ def run_engine_multiproc(cfg: BenchConfig, input_path: str, outputs_dir: str,
              "--input", input_path,
              "--coordinator", f"localhost:{port}",
              "--processes", str(cfg.procs), "--warmup"]
-    if cfg.mesh_shape is not None:
-        argv0 += ["--mesh", f"{cfg.mesh_shape[0]},{cfg.mesh_shape[1]}"]
-    if cfg.use_pallas:
-        argv0.append("--pallas")
-    if cfg.select != "auto":
-        argv0 += ["--select", cfg.select]
+    argv0 += _engine_flags(cfg, cfg.mode)
     procs = [subprocess.Popen(argv0 + ["--process-id", str(pid)],
                               stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                               env=env)

@@ -66,10 +66,17 @@ class ShardedEngine:
         dsh = NamedSharding(self.mesh, P(DATA_AXIS, None))
         dsh1 = NamedSharding(self.mesh, P(DATA_AXIS))
         qsh = NamedSharding(self.mesh, P(QUERY_AXIS, None))
-        return (jax.device_put(jnp.asarray(attrs, self._dtype), dsh),
-                jax.device_put(jnp.asarray(labels), dsh1),
-                jax.device_put(jnp.asarray(ids), dsh1),
-                jax.device_put(jnp.asarray(q_attrs, self._dtype), qsh))
+        # One-hop staging: device_put with the target sharding directly.
+        # jnp.asarray first would land the full array on the default device
+        # and reshard from there — a second full copy, and on a tunneled
+        # host link a second full transfer.
+        import ml_dtypes
+        np_dtype = (ml_dtypes.bfloat16 if self._dtype == jnp.bfloat16
+                    else np.float32)
+        return (jax.device_put(attrs.astype(np_dtype, copy=False), dsh),
+                jax.device_put(labels, dsh1),
+                jax.device_put(ids, dsh1),
+                jax.device_put(q_attrs.astype(np_dtype, copy=False), qsh))
 
     # -- the compiled sharded program ---------------------------------------
     def _fn(self, k: int, data_block: int, select: str):

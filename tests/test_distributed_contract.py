@@ -144,3 +144,55 @@ def test_two_process_tiny_input_empty_shard(tmp_path):
     assert all(p.returncode == 0 for p in procs), \
         [o[1].decode()[-2000:] for o in outs]
     assert outs[0][0].decode() == want
+
+
+def test_four_process_contract_run_matches_golden(tmp_path):
+    """VERDICT r2 item 6: beyond 2 processes. 4 procs x 2 devices form a
+    (4, 2) mesh, one data-axis row per process."""
+    text = generate_input_text(193, 17, 4, -3, 3, 1, 10, 4, seed=13)
+    path = tmp_path / "in4.txt"
+    path.write_text(text)
+    want = format_results(knn_golden(parse_input_text(text)))
+
+    port = _free_port()
+    procs = [_spawn(path, port, 4, pid, devices_per_proc=2)
+             for pid in (0, 1, 2, 3)]
+    outs = [p.communicate(timeout=240) for p in procs]
+    assert all(p.returncode == 0 for p in procs), \
+        [o[1].decode()[-2000:] for o in outs]
+    assert outs[0][0].decode() == want
+    assert all(outs[pid][0].decode() == "" for pid in (1, 2, 3))
+
+
+def test_two_process_four_devices_spans_data_rows(tmp_path):
+    """VERDICT r2 item 6: a process owning multiple data-axis rows — 2
+    procs x 4 devices on the auto (4, 2) mesh, each process spans two
+    rows of the data axis (the exact shape the r1 advisory warned
+    shard_bounds-style arithmetic gets wrong)."""
+    text = generate_input_text(301, 19, 5, -6, 6, 1, 14, 5, seed=31)
+    path = tmp_path / "in24.txt"
+    path.write_text(text)
+    want = format_results(knn_golden(parse_input_text(text)))
+
+    port = _free_port()
+    procs = [_spawn(path, port, 2, pid, devices_per_proc=4)
+             for pid in (0, 1)]
+    outs = [p.communicate(timeout=240) for p in procs]
+    assert all(p.returncode == 0 for p in procs), \
+        [o[1].decode()[-2000:] for o in outs]
+    assert outs[0][0].decode() == want
+
+
+def test_process_slice_rejects_non_contiguous_block():
+    """The documented error path (VERDICT r2 item 6): a layout whose
+    process-addressable shards leave a gap must raise, not feed wrong
+    rows."""
+    from dmlp_tpu.parallel.distributed import process_slice
+
+    class GappySharding:
+        def addressable_devices_indices_map(self, shape):
+            return {"d0": (slice(0, 8), slice(None)),
+                    "d1": (slice(16, 24), slice(None))}
+
+    with pytest.raises(ValueError, match="not contiguous"):
+        process_slice(GappySharding(), (32, 4))
