@@ -399,6 +399,10 @@ class SingleChipEngine:
             flags_dev = _device_flags(top.dists, jnp.asarray(ks_pad))
 
         t0 = _time.perf_counter()
+        # NOTE: the "fetch" phase time includes the wait for all enqueued
+        # device work (staging + solve), not just the readback bytes — the
+        # enqueue phase above is host dispatch only. Don't read this table
+        # as "readback costs X ms".
         fetch = ([] if self.config.exact else [top.dists]) + [top.ids] \
             + ([flags_dev] if flags_dev is not None else [])
         fetched = list(jax.device_get(fetch))
