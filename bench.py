@@ -86,13 +86,15 @@ def _time_extract_solve_ms(inp, repeats: int, use_pallas: bool):
     from dmlp_tpu.ops.pallas_extract import extract_topk
     from dmlp_tpu.ops.pallas_extract import supports as extract_supports
 
+    from dmlp_tpu.ops.pallas_extract import BLOCK_ROWS, QUERY_TILE
+
     n, a = inp.data_attrs.shape
     nq = inp.params.num_queries
     k = round_up(int(inp.ks.max()) + 8, 8)
-    # Whole 8192-row blocks / 512-row query tiles: awkward sizes otherwise
-    # tile degenerately (see config.resolve_granule("extract")).
-    npad = round_up(n, 8192)
-    qpad = round_up(nq, 512)
+    # Whole extraction blocks / query tiles: awkward sizes otherwise tile
+    # degenerately (see config.resolve_granule("extract")).
+    npad = round_up(n, BLOCK_ROWS)
+    qpad = round_up(nq, QUERY_TILE)
     if not (use_pallas and extract_supports(qpad, npad, a, k)):
         return None
     d = jnp.zeros((npad, a), jnp.float32).at[:n].set(

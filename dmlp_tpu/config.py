@@ -118,18 +118,20 @@ class EngineConfig:
     def resolve_granule(self, select: str) -> int:
         """data_block granularity: whole 1024-column Pallas tiles for the
         fused seg producer, whole 128-column segments for XLA seg, whole
-        512-row extraction blocks for "extract", 8 rows otherwise (must
-        stay in sync with ops.pallas_distance/pallas_extract supports)."""
+        extraction blocks (pallas_extract.BLOCK_ROWS) for "extract",
+        8 rows otherwise (must stay in sync with
+        ops.pallas_distance/pallas_extract supports)."""
         if select == "seg":
             return 1024 if self.use_pallas else 128
         if select == "extract":
-            # Full extraction blocks: a merely-512-divisible size can have
-            # no large divisor (200000 pads to 512*391, 391 = 17*23, so the
-            # largest tileable block is 512 — measured 4x slower than the
-            # 8192 blocks a 512*392 pad allows). Padding to whole blocks
-            # wastes <= 8191 sentinel rows (~2% at the benchmark shape) and
-            # keeps the block size maximal.
-            return 8192
+            # Whole extraction blocks (ops.pallas_extract._TN): a merely
+            # lane-divisible size can have no large divisor (200000 pads to
+            # 512*391, 391 = 17*23, so the largest tileable block would be
+            # 512 — measured 4x slower). Padding to whole blocks wastes
+            # < _TN sentinel rows (~2% at the benchmark shape) and keeps
+            # the block size maximal.
+            from dmlp_tpu.ops.pallas_extract import BLOCK_ROWS
+            return BLOCK_ROWS
         return 8
 
     def resolve_data_block(self, select: str) -> int:

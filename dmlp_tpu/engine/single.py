@@ -318,10 +318,11 @@ class SingleChipEngine:
         granule = cfg.resolve_granule("extract")
         t0 = _time.perf_counter()
         npad, nchunks, chunk_rows = plan_chunks(n, granule, cfg.data_block)
-        # Queries pad to a whole 512-row tile for the same reason data pads
-        # to whole 8192-row blocks: an awkward qb (e.g. 8 * prime) would
+        # Queries pad to a whole query tile for the same reason data pads
+        # to whole extraction blocks: an awkward qb (e.g. 8 * prime) would
         # force a degenerate 8-row query tile.
-        qpad = round_up(nq, 512)
+        from dmlp_tpu.ops.pallas_extract import QUERY_TILE
+        qpad = round_up(nq, QUERY_TILE)
         kmax = int(inp.ks.max())
         k = resolve_kcap(cfg, kmax, "extract", nchunks * chunk_rows)
         if not extract_supports(qpad, chunk_rows, na, k):

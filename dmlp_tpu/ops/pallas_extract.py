@@ -44,18 +44,29 @@ from jax.experimental.pallas import tpu as pltpu
 
 from dmlp_tpu.ops.pallas_distance import _tile
 
-_TQ = 512    # query rows per tile
-_TN = 8192   # data rows per block (4 quarters of 2048 lanes)
-_E = 4       # extraction candidates per loop iteration (quarter minima)
+# Swept on v5e at 204800 x 10240 x 64, kc=40 (r3): small query tiles win
+# (the while loop runs max-over-rows extra iterations, so fewer rows per
+# tile means fewer wasted passes) and two half-block minima per pass beat
+# one or four. (128, 12800, 2) measured 68 ms vs 148 ms for the previous
+# (512, 8192, 4) default.
+_TQ = 128    # query rows per tile
+_TN = 12800  # data rows per block
+_E = 2       # extraction candidates per loop iteration (half-block minima)
+
+# Public padding contract for callers (engine.single, bench): pad data to
+# whole BLOCK_ROWS blocks and queries to whole QUERY_TILE tiles so _tile
+# never degenerates (see config.resolve_granule("extract")).
+BLOCK_ROWS = _TN
+QUERY_TILE = _TQ
 
 
 def supports(qb: int, b: int, a: int, kc: int) -> bool:
-    """Shapes the kernel can tile: whole quarters (tn % 512), query tiles
-    of 8, kc no wider than one block, and VMEM room for the distance
-    scratch + double-buffered q/d blocks."""
-    if qb % 8 != 0 or b % 512 != 0:
+    """Shapes the kernel can tile: whole lane-width sub-blocks
+    (b % (128 * _E)), query tiles of 8, kc no wider than one block, and
+    VMEM room for the distance scratch + double-buffered q/d blocks."""
+    if qb % 8 != 0 or b % (128 * _E) != 0:
         return False
-    tn = _tile(b, _TN, 512)
+    tn = _tile(b, _TN, 128 * _E)
     tq = _tile(qb, _TQ, 8)
     if kc > tn or kc > 512:
         return False

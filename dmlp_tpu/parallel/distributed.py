@@ -198,7 +198,12 @@ def plan_shapes(engine, n: int, nq: int):
 
     cfg = engine.config
     r, c = engine.mesh.devices.shape
-    select = cfg.resolve_select(round_up(max(-(-n // r), 1), 8))
+    # resolve_streaming_select: the mesh engines run the remapped select
+    # (shard_map has array ids, so "extract" becomes "seg"/"topk"), and
+    # the granule must match what actually runs — the extract granule
+    # (12800) has no 1024-multiple divisor, which would silently knock
+    # the shards off the fused Pallas seg producer.
+    select = cfg.resolve_streaming_select(round_up(max(-(-n // r), 1), 8))
     granule = cfg.resolve_granule(select)
     shard_rows = round_up(max(-(-n // r), 1), granule)
     qpad = c * round_up(max(-(-nq // c), 1), 8)

@@ -109,7 +109,11 @@ def decompose(points: list) -> Optional[dict]:
     a = np.vstack([np.ones_like(x), x]).T
     (t_compute, t_ar), *_ = np.linalg.lstsq(a, t, rcond=None)
     resid = t - a @ np.array([t_compute, t_ar])
-    base = min(p["step_time_ms"] for p in points if p["n_chips"] == n.min())
+    # The fitted t_compute is the compute-only floor (a 1-chip rung's
+    # step time when present; extrapolated otherwise) — using the
+    # smallest rung directly would hide that rung's own collective cost
+    # when the sweep starts above n=1.
+    base = float(t_compute)
     for p in points:
         p["comm_overhead_ms"] = round(p["step_time_ms"] - base, 2)
         p["comm_fraction"] = round(
@@ -128,15 +132,24 @@ def run_sweep(mesh_sizes: Sequence[int], dims: Sequence[int],
     for n in mesh_sizes:
         point = sweep_point(n, dims, batch_per_chip, steps, dtype, offload)
         results.append(point)
+        if out is not None:
+            # Stream each rung as it lands — the largest mesh is exactly
+            # where a crash/preemption happens, and earlier rungs must
+            # survive it. The decomposition fields are appended to the
+            # summary line instead of mutating already-written points.
+            out.write(json.dumps(point) + "\n")
+            out.flush()
     fit = decompose(results)
     if out is not None:
-        for point in results:
-            out.write(json.dumps(point) + "\n")
         if fit is not None:
-            import jax as _jax
-            virtual = _jax.devices()[0].platform == "cpu"
+            virtual = jax.devices()[0].platform == "cpu"
             out.write(json.dumps({
                 "summary": fit,
+                "per_rung_comm": [
+                    {"n_chips": p["n_chips"],
+                     "comm_overhead_ms": p["comm_overhead_ms"],
+                     "comm_fraction": p["comm_fraction"]}
+                    for p in results],
                 "scope": ("plumbing-only: virtual CPU devices share the "
                           "same physical cores, so the overhead term "
                           "absorbs compute contention as well as the "
