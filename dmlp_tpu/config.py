@@ -105,11 +105,14 @@ class EngineConfig:
 
     def resolve_streaming_select(self, padded_rows: int) -> str:
         """Like resolve_select, for paths that fold blocks with arbitrary
-        id arrays (the mesh engines' shard_map programs, the chunk-fold
-        driver): the extraction kernel needs trace-time-affine ids, so
-        "extract" maps to the best array-ids strategy there. Engines must
-        record THIS value as _last_select — gating the tie repair on a
-        nominal "extract" would silently skip it."""
+        (non-affine) id arrays — the chunk-fold driver's fallback, the
+        multi-host per-shard programs: the extraction kernel needs
+        affine per-shard ids, so "extract" maps to the best array-ids
+        strategy there. Paths that DO satisfy the affine-ids contract
+        (engine.single's chunk loop, the mesh engines' contiguous shards)
+        run "extract" natively and legitimately record it as
+        _last_select; every run() tie-repair gate lists "extract"
+        alongside "topk"/"seg" (same tie semantics)."""
         select = self.resolve_select(padded_rows)
         if select == "extract":
             return "seg" if self.use_pallas else "topk"

@@ -35,7 +35,7 @@ from dmlp_tpu.engine.single import (fit_blocks, pad_dataset, resolve_kcap,
                                     round_up)
 from dmlp_tpu.io.grammar import KNNInput
 from dmlp_tpu.io.report import QueryResult
-from dmlp_tpu.ops.topk import streaming_topk
+from dmlp_tpu.ops.topk import TopK, streaming_topk
 from dmlp_tpu.parallel.collectives import allgather_merge_topk, ring_allreduce_topk
 from dmlp_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS, make_mesh
 
@@ -53,14 +53,14 @@ class ShardedEngine:
         self._fns: Dict[Tuple[int, int, str], object] = {}  # (k, block, select)
 
     # -- sharded placement ---------------------------------------------------
-    def _shard_inputs(self, inp: KNNInput, data_block: int):
+    def _shard_inputs(self, inp: KNNInput, data_block: int, qgran: int = 8):
         r, c = self.mesh.devices.shape
         q = inp.params.num_queries
         na = inp.params.num_attrs
         # r * round_up(ceil(n/r), b) == round_up(n, r*b), so the per-shard
         # row count divides data_block as streaming_topk requires.
         attrs, labels, ids = pad_dataset(inp, r * data_block, np.float32)
-        qpad = c * round_up(max(-(-q // c), 1), 8)
+        qpad = c * round_up(max(-(-q // c), 1), qgran)
         q_attrs = np.zeros((qpad, na), np.float32); q_attrs[:q] = inp.query_attrs
 
         dsh = NamedSharding(self.mesh, P(DATA_AXIS, None))
@@ -79,16 +79,48 @@ class ShardedEngine:
                 jax.device_put(q_attrs.astype(np_dtype, copy=False), qsh))
 
     # -- the compiled sharded program ---------------------------------------
+    def _solve_shard_fn(self, k: int, data_block: int, select: str):
+        """Per-cell solver closure: the flagship extraction kernel when the
+        plan selected it (its SMEM runtime scalars make the per-shard
+        id_base/n_real traced values, so one compiled kernel serves every
+        shard), the streaming fold otherwise. Returns possibly-UNSORTED
+        lists — both merges re-select with the composite sort."""
+        if select == "extract":
+            from dmlp_tpu.ops.pallas_distance import native_pallas_backend
+            from dmlp_tpu.ops.pallas_extract import extract_topk
+            interpret = not native_pallas_backend()
+
+            def solve_shard(data_a, data_l, data_i, q_attrs):
+                sr = data_a.shape[0]
+                # Shards hold contiguous global rows with sentinel tails
+                # (pad_dataset / padded_shard), so ids are affine per
+                # shard: base from the first id, count from the mask.
+                nreal = jnp.sum((data_i >= 0).astype(jnp.int32))
+                base = jnp.maximum(data_i[0], 0)
+                od, oi, _ = extract_topk(q_attrs, data_a, n_real=nreal,
+                                         id_base=base, kc=k,
+                                         interpret=interpret)
+                lab = jnp.where(
+                    oi >= 0, data_l[jnp.clip(oi - base, 0, sr - 1)], -1)
+                return TopK(od, lab, oi)
+            return solve_shard
+
+        use_pallas = self.config.use_pallas
+
+        def solve_shard(data_a, data_l, data_i, q_attrs):
+            return streaming_topk(q_attrs, data_a, data_l, data_i,
+                                  k=k, data_block=data_block,
+                                  select=select, use_pallas=use_pallas)
+        return solve_shard
+
     def _fn(self, k: int, data_block: int, select: str):
         key = (k, data_block, select)
         if key not in self._fns:
             merge = self._merge_strategy
-            use_pallas = self.config.use_pallas
+            solve_shard = self._solve_shard_fn(k, data_block, select)
 
             def local(data_a, data_l, data_i, q_attrs):
-                top = streaming_topk(q_attrs, data_a, data_l, data_i,
-                                     k=k, data_block=data_block,
-                                     select=select, use_pallas=use_pallas)
+                top = solve_shard(data_a, data_l, data_i, q_attrs)
                 if merge == "allgather":
                     return allgather_merge_topk(top, k, DATA_AXIS)
                 return ring_allreduce_topk(top, k, DATA_AXIS)
@@ -103,11 +135,30 @@ class ShardedEngine:
         return self._fns[key]
 
     # -- public API ----------------------------------------------------------
-    def candidates(self, inp: KNNInput):
+    def _plan_local(self, inp: KNNInput):
+        """(select, data_block, qgran, k) for the single-host merged path.
+        Prefers the extraction kernel when the per-shard tiling supports
+        it (per-cell queries then pad to whole QUERY_TILE tiles, like
+        engine.single — an 8*prime count would degenerate to an 8-row
+        tile), else the streaming select; explicit data_block pins
+        streaming (the kernel chooses its own block sizes). The returned
+        ``k`` is exactly the value the supports() gate validated."""
         cfg = self.config
         n = inp.params.num_data
-        r = self.mesh.devices.shape[0]
+        r, c = self.mesh.devices.shape
+        kmax = int(inp.ks.max()) if inp.params.num_queries else 1
         shard_rows_est = round_up(max(-(-n // r), 1), 8)
+        if cfg.data_block is None \
+                and cfg.resolve_select(shard_rows_est) == "extract":
+            from dmlp_tpu.ops.pallas_extract import QUERY_TILE
+            from dmlp_tpu.ops.pallas_extract import supports as ex_supports
+            sr = round_up(max(-(-n // r), 1),
+                          cfg.resolve_granule("extract"))
+            qb_local = round_up(max(-(-inp.params.num_queries // c), 1),
+                                QUERY_TILE)
+            k = resolve_kcap(cfg, kmax, "extract", sr * r)
+            if ex_supports(qb_local, sr, inp.params.num_attrs, k):
+                return "extract", sr, QUERY_TILE, k
         select = cfg.resolve_streaming_select(shard_rows_est)
         if cfg.data_block is not None:
             data_block = min(cfg.data_block, shard_rows_est)
@@ -115,10 +166,15 @@ class ShardedEngine:
             data_block = fit_blocks(max(-(-n // r), 1),
                                     cfg.resolve_data_block(select),
                                     granule=cfg.resolve_granule(select))
-        d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(inp, data_block)
-        kmax = int(inp.ks.max()) if inp.params.num_queries else 1
-        shard_rows = d_attrs.shape[0] // r
-        k = resolve_kcap(cfg, kmax, select, shard_rows * r)
+        shard_rows = round_up(max(-(-n // r), 1), data_block)
+        return select, data_block, 8, resolve_kcap(cfg, kmax, select,
+                                                   shard_rows * r)
+
+    def candidates(self, inp: KNNInput):
+        r = self.mesh.devices.shape[0]
+        select, data_block, qgran, k = self._plan_local(inp)
+        d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(
+            inp, data_block, qgran)
 
         self._last_select = select  # run() gates the tie-overflow repair
         top = self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
@@ -208,7 +264,7 @@ class ShardedEngine:
         dists, labels, ids = self.candidates(inp)
         results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
                                 inp.data_attrs, exact=self.config.exact)
-        if self._last_select in ("topk", "seg") \
+        if self._last_select in ("topk", "seg", "extract") \
                 and dists.shape[1] < inp.params.num_data:
             # Per-shard truncation of a tie group surfaces as the same
             # boundary equality on the merged lists (the tie value fills the

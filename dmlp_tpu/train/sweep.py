@@ -109,11 +109,12 @@ def decompose(points: list) -> Optional[dict]:
     a = np.vstack([np.ones_like(x), x]).T
     (t_compute, t_ar), *_ = np.linalg.lstsq(a, t, rcond=None)
     resid = t - a @ np.array([t_compute, t_ar])
-    # The fitted t_compute is the compute-only floor (a 1-chip rung's
-    # step time when present; extrapolated otherwise) — using the
-    # smallest rung directly would hide that rung's own collective cost
-    # when the sweep starts above n=1.
-    base = float(t_compute)
+    # Compute-only floor: the measured 1-chip rung when present (its comm
+    # term is exactly zero), else the fitted intercept as an extrapolated
+    # fallback — the intercept alone misreports fit residual as per-rung
+    # communication when the model fits poorly (virtual-device contention).
+    ones = [p["step_time_ms"] for p in points if p["n_chips"] == 1]
+    base = float(ones[0]) if ones else float(t_compute)
     for p in points:
         p["comm_overhead_ms"] = round(p["step_time_ms"] - base, 2)
         p["comm_fraction"] = round(
