@@ -194,23 +194,34 @@ class ShardedEngine:
         divisible by the data-axis size, query rows by the query-axis
         size). Returns the merged TopK (global, query-sharded).
         """
-        select, data_block, k = self._plan_shard(d_attrs, kmax,
+        select, data_block, k = self._plan_shard(d_attrs, q_attrs, kmax,
                                                  merged_width=True)
         return self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
                                                q_attrs)
 
-    def _plan_shard(self, d_attrs, kmax: int, merged_width: bool):
+    def _plan_shard(self, d_attrs, q_attrs, kmax: int, merged_width: bool):
         """Per-shard blocking plan for pre-placed global arrays.
 
-        ``merged_width`` sizes the candidate width for the cross-shard
-        merged output (cap R * shard_rows); per-shard outputs
-        (solve_local_shards) cap at shard_rows. Sets _last_select.
+        Prefers the extraction kernel when the feed's (fixed) per-shard
+        shapes support it; else the streaming select. ``merged_width``
+        sizes the candidate width for the cross-shard merged output
+        (cap R * shard_rows); per-shard outputs (solve_local_shards) cap
+        at shard_rows. Sets _last_select.
         """
         from dmlp_tpu.ops.pallas_distance import _tile
 
         cfg = self.config
-        r = self.mesh.devices.shape[0]
+        r, c = self.mesh.devices.shape
         shard_rows = d_attrs.shape[0] // r
+        cap = shard_rows * r if merged_width else shard_rows
+        if cfg.data_block is None \
+                and cfg.resolve_select(shard_rows) == "extract":
+            from dmlp_tpu.ops.pallas_extract import supports as ex_supports
+            k = resolve_kcap(cfg, kmax, "extract", cap)
+            if ex_supports(q_attrs.shape[0] // c, shard_rows,
+                           d_attrs.shape[1], k):
+                self._last_select = "extract"
+                return "extract", shard_rows, k
         select = cfg.resolve_streaming_select(shard_rows)
         granule = cfg.resolve_granule(select)
         # _tile snaps to the largest granule-multiple divisor of shard_rows
@@ -219,8 +230,7 @@ class ShardedEngine:
                            min(cfg.data_block or
                                cfg.resolve_data_block(select), shard_rows),
                            min(granule, shard_rows))
-        k = resolve_kcap(cfg, kmax, select,
-                         shard_rows * r if merged_width else shard_rows)
+        k = resolve_kcap(cfg, kmax, select, cap)
         self._last_select = select
         return select, data_block, k
 
@@ -234,12 +244,17 @@ class ShardedEngine:
         merge must not happen in f32 on device first."""
         key = ("local", k, data_block, select)
         if key not in self._fns:
-            use_pallas = self.config.use_pallas
+            solve_shard = self._solve_shard_fn(k, data_block, select)
 
             def local(data_a, data_l, data_i, q_attrs):
-                top = streaming_topk(q_attrs, data_a, data_l, data_i,
-                                     k=k, data_block=data_block,
-                                     select=select, use_pallas=use_pallas)
+                top = solve_shard(data_a, data_l, data_i, q_attrs)
+                if select == "extract":
+                    # The multi-host rescore reads kth/last POSITIONS of
+                    # each per-shard list (tie-hazard check), so the
+                    # extraction kernel's unsorted lists must be sorted
+                    # here; the merged path's collectives re-sort anyway.
+                    from dmlp_tpu.ops.topk import select_topk
+                    top = select_topk(top.dists, top.labels, top.ids, k)
                 return jax.tree.map(lambda t: t[None], top)  # (1, qloc, K)
 
             sharded = jax.shard_map(
@@ -255,7 +270,7 @@ class ShardedEngine:
                            kmax: int):
         """Like solve_global, but returns per-shard candidate lists
         (TopK of shape (R, Qpad, K), sharded over both mesh axes)."""
-        select, data_block, k = self._plan_shard(d_attrs, kmax,
+        select, data_block, k = self._plan_shard(d_attrs, q_attrs, kmax,
                                                  merged_width=False)
         return self._fn_local(k, data_block, select)(d_attrs, d_labels,
                                                      d_ids, q_attrs)

@@ -198,15 +198,23 @@ def plan_shapes(engine, n: int, nq: int):
 
     cfg = engine.config
     r, c = engine.mesh.devices.shape
-    # resolve_streaming_select: the mesh engines run the remapped select
-    # (shard_map has array ids, so "extract" becomes "seg"/"topk"), and
-    # the granule must match what actually runs — the extract granule
-    # (12800) has no 1024-multiple divisor, which would silently knock
-    # the shards off the fused Pallas seg producer.
-    select = cfg.resolve_streaming_select(round_up(max(-(-n // r), 1), 8))
-    granule = cfg.resolve_granule(select)
+    rows_est = round_up(max(-(-n // r), 1), 8)
+    qgran = 8
+    if cfg.data_block is None and cfg.resolve_select(rows_est) == "extract":
+        # The per-shard solver (_plan_shard) will pick the extraction
+        # kernel when these shapes support it, so pad shards to whole
+        # extraction blocks and query shards to whole query tiles — a
+        # merely-lane-divisible shard would tile degenerately (see
+        # config.resolve_granule). If _plan_shard later falls back (e.g.
+        # kcap past the kernel's cap), the streaming selects still accept
+        # these shapes, just at non-ideal blocking — slower, never wrong.
+        from dmlp_tpu.ops.pallas_extract import QUERY_TILE
+        granule = cfg.resolve_granule("extract")
+        qgran = QUERY_TILE
+    else:
+        granule = cfg.resolve_granule(cfg.resolve_streaming_select(rows_est))
     shard_rows = round_up(max(-(-n // r), 1), granule)
-    qpad = c * round_up(max(-(-nq // c), 1), 8)
+    qpad = c * round_up(max(-(-nq // c), 1), qgran)
     return r * shard_rows, shard_rows, qpad
 
 

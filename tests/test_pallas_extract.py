@@ -189,3 +189,46 @@ def test_sharded_engine_extract_duplicate_ties():
     got = eng.run(inp)
     assert eng._last_select == "extract"
     assert_same_results(got, knn_golden(inp), check_dists=False)
+
+
+def test_plan_shard_prefers_extract_when_supported():
+    """The pre-placed-array plan (multi-host path) picks the extraction
+    kernel when the feed's fixed per-shard shapes can tile it, and falls
+    back gracefully when they cannot (kcap past the 512 candidate cap)."""
+    from dmlp_tpu.engine.sharded import ShardedEngine
+    from dmlp_tpu.parallel.mesh import make_mesh
+
+    eng = ShardedEngine(EngineConfig(mode="sharded", use_pallas=True),
+                        mesh=make_mesh())
+    r, c = eng.mesh.devices.shape
+    d = np.zeros((12800 * r, 8), np.float32)
+    q = np.zeros((128 * c, 8), np.float32)
+    sel, _, k = eng._plan_shard(d, q, 16, merged_width=True)
+    assert sel == "extract" and k >= 16
+    sel2, _, _ = eng._plan_shard(d, q, 600, merged_width=True)  # kcap > 512
+    assert sel2 != "extract"
+
+
+def test_contract_run_extract_path_matches_golden(tmp_path):
+    """Full multi-host contract pipeline (sharded feed -> per-shard
+    extraction kernel -> distributed f64 rescore -> merge) on the
+    (4,2) virtual mesh, single process, golden parity."""
+    import os as _os
+
+    from dmlp_tpu.engine.sharded import ShardedEngine
+    from dmlp_tpu.parallel.distributed import distributed_contract_run
+    from dmlp_tpu.parallel.mesh import make_mesh
+
+    text = generate_input_text(1024, 24, 5, -6, 6, 1, 12, 4, seed=41)
+    path = tmp_path / "ex.txt"
+    path.write_text(text)
+    inp = parse_input_text(text)
+    want = [r.checksum() for r in knn_golden(inp)]
+
+    eng = ShardedEngine(EngineConfig(mode="sharded", select="extract",
+                                     use_pallas=True), mesh=make_mesh())
+    with open(_os.devnull, "w") as devnull:
+        got = distributed_contract_run(str(path), eng,
+                                       out=devnull, err=devnull)
+    assert eng._last_select == "extract"
+    assert [r.checksum() for r in got] == want
