@@ -115,7 +115,8 @@ class EngineConfig:
         alongside "topk"/"seg" (same tie semantics)."""
         select = self.resolve_select(padded_rows)
         if select == "extract":
-            return "seg" if self.use_pallas else "topk"
+            from dmlp_tpu.ops.topk import streaming_fallback
+            return streaming_fallback(self.use_pallas)
         return select
 
     def resolve_granule(self, select: str) -> int:

@@ -190,7 +190,10 @@ class SingleChipEngine:
     def _prep(self, inp: KNNInput):
         cfg = self.config
         n = inp.params.num_data
-        select = cfg.resolve_select(round_up(max(n, 1), 8))
+        # The scan/device-full paths fold arbitrary-id blocks, so
+        # "extract" remaps here (and the granule must match what runs —
+        # the extract granule has no 1024-divisor for the seg producer).
+        select = cfg.resolve_streaming_select(round_up(max(n, 1), 8))
         if cfg.data_block is not None:
             data_block = min(cfg.data_block, round_up(max(n, 1), 8))
         else:

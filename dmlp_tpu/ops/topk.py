@@ -25,6 +25,14 @@ import jax
 import jax.numpy as jnp
 
 
+def streaming_fallback(use_pallas: bool) -> str:
+    """The array-ids selection strategy used wherever the extraction
+    kernel cannot run (it needs affine per-block ids): the fused seg
+    producer with Pallas, plain lax.top_k without. Single definition —
+    config.resolve_streaming_select and streaming_topk both use it."""
+    return "seg" if use_pallas else "topk"
+
+
 class TopK(NamedTuple):
     """Per-query candidate lists, sorted by the selection order.
 
@@ -102,10 +110,11 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
     qb = query_attrs.shape[0]
 
     if select == "extract":
-        # The extraction kernel needs trace-time-affine ids (engine.single
-        # drives it directly); inside this generic streaming fold the ids
-        # are arbitrary arrays, so fall back to the best array-ids path.
-        select = "seg" if use_pallas else "topk"
+        # The extraction kernel needs affine ids; this generic streaming
+        # fold gets arbitrary id arrays, so apply the shared array-ids
+        # fallback policy (config.resolve_streaming_select delegates to
+        # the same function — one definition, no drift).
+        select = streaming_fallback(use_pallas)
 
     blocks = (data_attrs.reshape(nblocks, data_block, -1),
               data_labels.reshape(nblocks, data_block),

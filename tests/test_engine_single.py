@@ -122,10 +122,12 @@ def test_bf16_exact_mode_matches_golden():
     stays the benchmarked dtype.)"""
     text = generate_input_text(2000, 80, 16, -50, 50, 1, 32, 6, seed=3)
     inp = parse_input_text(text)
-    for select in ("topk", "seg"):
+    for select in ("topk", "seg", "extract"):
         eng = SingleChipEngine(EngineConfig(dtype="bfloat16", exact=True,
-                                            select=select))
+                                            select=select,
+                                            use_pallas=select == "extract"))
         assert_same_results(eng.run(inp), knn_golden(inp), check_dists=False)
+        assert eng._last_select == select  # no silent fallback
 
 
 def test_bf16_exact_duplicate_heavy_ties():
