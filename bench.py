@@ -247,7 +247,7 @@ def main() -> int:
     baseline_ms = time_baseline_ms(inp, k)
 
     pairs_per_s = num_data * num_queries / (engine_ms / 1e3)
-    print(json.dumps({
+    out = {
         "metric": "knn_solve_ms",
         "value": round(engine_ms, 3),
         "unit": "ms",
@@ -257,7 +257,18 @@ def main() -> int:
         "shape": {"num_data": num_data, "num_queries": num_queries,
                   "num_attrs": num_attrs, "k": k, "mode": mode},
         "path": path,
-    }))
+    }
+    # Promote the fenced on-chip number: `value` includes host<->device
+    # transfers, which on a tunneled link (10-50 MB/s measured) swing 2-4x
+    # with link weather; the device solve is the architecture-bound,
+    # run-to-run-comparable metric.
+    dev = {k_: v for k_, v in path["phases_ms"].items()
+           if k_.startswith("device_solve_ms_")}
+    if dev:
+        out["device_solve_ms"] = min(dev.values())
+        out["device_qd_pairs_per_sec"] = round(
+            num_data * num_queries / (out["device_solve_ms"] / 1e3))
+    print(json.dumps(out))
     return 0
 
 
