@@ -1,0 +1,78 @@
+"""Randomized differential sweep of the extraction path (CPU interpret).
+
+The fixed tests cover designed cases; this sweep hardens the flagship
+select="extract" engine against shape edge cases: random sizes straddling
+pad granules and duplicate-heavy grids (seed sweep), plus dedicated
+k == n / single-query / 1-point cases the random seeds don't reach —
+every case diffs against the float64 golden model, so any algorithmic or
+padding bug is a checksum mismatch, not a tolerance judgement.
+"""
+
+import numpy as np
+import pytest
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.engine.single import SingleChipEngine
+from dmlp_tpu.golden.reference import knn_golden
+from dmlp_tpu.io.grammar import KNNInput, Params
+from tests.test_engine_single import assert_same_results
+
+
+def _case(seed: int) -> KNNInput:
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 700))
+    nq = int(rng.integers(1, 40))
+    na = int(rng.integers(1, 9))
+    dup = rng.random() < 0.4
+    if dup:  # integer grid: exact f32 + massive tie groups
+        data = rng.integers(0, 3, (n, na)).astype(np.float64)
+        queries = rng.integers(0, 3, (nq, na)).astype(np.float64)
+    else:
+        data = rng.uniform(-20, 20, (n, na))
+        queries = rng.uniform(-20, 20, (nq, na))
+    labels = rng.integers(0, int(rng.integers(1, 6)) + 1, n).astype(np.int32)
+    kmax = int(rng.integers(1, min(n, 48) + 1))
+    ks = rng.integers(1, kmax + 1, nq).astype(np.int32)
+    if rng.random() < 0.25:
+        ks[0] = min(n, 48)  # k at (or near) the dataset size
+    return KNNInput(Params(n, nq, na), labels, data, ks, queries)
+
+
+@pytest.mark.parametrize("seed", range(101, 119))
+def test_extract_engine_random_shapes_match_golden(seed):
+    inp = _case(seed)
+    eng = SingleChipEngine(EngineConfig(select="extract", use_pallas=True))
+    assert_same_results(eng.run(inp), knn_golden(inp), check_dists=False)
+
+
+@pytest.mark.parametrize("n,nq,kfull", [(37, 5, True), (48, 1, True),
+                                        (513, 1, False), (1, 3, True)])
+def test_extract_engine_kn_and_single_query_edges(n, nq, kfull):
+    """The edge cases random seeds don't reach: k == n (every real point
+    is a neighbor; sentinel padding must fill the rest), a single query
+    row, and a 1-point dataset."""
+    rng = np.random.default_rng(7 * n + nq)
+    na = 4
+    data = rng.uniform(-5, 5, (n, na))
+    queries = rng.uniform(-5, 5, (nq, na))
+    labels = rng.integers(0, 3, n).astype(np.int32)
+    ks = np.full(nq, n if kfull else 48, np.int32)
+    inp = KNNInput(Params(n, nq, na), labels, data, ks, queries)
+    eng = SingleChipEngine(EngineConfig(select="extract", use_pallas=True))
+    assert_same_results(eng.run(inp), knn_golden(inp), check_dists=False)
+
+
+@pytest.mark.parametrize("seed", [301, 302, 303])
+def test_extract_engine_fast_mode_random_dup_grids(seed):
+    # fast mode (no f64 rescore) on exact-in-f32 integer grids: the
+    # boundary-overflow repair alone must deliver golden parity.
+    rng = np.random.default_rng(seed)
+    n, nq, na = int(rng.integers(300, 900)), int(rng.integers(4, 24)), 3
+    data = rng.integers(0, 4, (n, na)).astype(np.float64)
+    queries = rng.integers(0, 4, (nq, na)).astype(np.float64)
+    labels = rng.integers(0, 4, n).astype(np.int32)
+    ks = rng.integers(1, 32, nq).astype(np.int32)
+    inp = KNNInput(Params(n, nq, na), labels, data, ks, queries)
+    eng = SingleChipEngine(EngineConfig(select="extract", use_pallas=True,
+                                        exact=False))
+    assert_same_results(eng.run(inp), knn_golden(inp), check_dists=False)
