@@ -78,7 +78,11 @@ def time_baseline_ms(inp, k: int, sample_queries: int = 1024,
 def _time_extract_solve_ms(inp, repeats: int, use_pallas: bool):
     """Fenced on-chip time of the fused extraction solve (select="extract",
     ops.pallas_extract): one call over the whole padded dataset — the
-    distance tile never reaches HBM. None when the kernel can't run here."""
+    distance tile never reaches HBM. The timed region includes the
+    label-gather + composite-sort epilogue (engine.single._extract_finalize)
+    so the number is scope-comparable with the seg/topk streaming folds,
+    which carry labels and merge inside the fold. None when the kernel
+    can't run here."""
     import jax
     import jax.numpy as jnp
 
@@ -97,14 +101,18 @@ def _time_extract_solve_ms(inp, repeats: int, use_pallas: bool):
     qpad = round_up(nq, QUERY_TILE)
     if not (use_pallas and extract_supports(qpad, npad, a, k)):
         return None
+    from dmlp_tpu.engine.single import _extract_finalize
+
     d = jnp.zeros((npad, a), jnp.float32).at[:n].set(
         jnp.asarray(inp.data_attrs, jnp.float32))
     q = jnp.zeros((qpad, a), jnp.float32).at[:nq].set(
         jnp.asarray(inp.query_attrs, jnp.float32))
+    lab = jnp.asarray(inp.labels, jnp.int32)
     float(jnp.sum(d))  # fence staging
 
     def fn(q_, d_):
-        return extract_topk(q_, d_, n_real=n, kc=k)[0]
+        od, oi, _ = extract_topk(q_, d_, n_real=n, kc=k)
+        return _extract_finalize(od, oi, lab, k=k).dists
 
     r = fn(q, d)
     _ = float(r[0, 0])           # compile + fence

@@ -139,8 +139,11 @@ def _config_env(cfg: BenchConfig, env: Optional[dict]) -> Optional[dict]:
         e.pop("PYTHONPATH", None)
     e["JAX_PLATFORMS"] = "cpu"
     e["PALLAS_AXON_POOL_IPS"] = ""
-    e["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={cfg.virtual_devices}")
+    # Append to (not replace) any caller/CI XLA_FLAGS; ours goes last so a
+    # stale device-count flag from the environment cannot override it.
+    prior = e.get("XLA_FLAGS", "")
+    mine = f"--xla_force_host_platform_device_count={cfg.virtual_devices}"
+    e["XLA_FLAGS"] = f"{prior} {mine}".strip()
     return e
 
 
@@ -205,43 +208,58 @@ def run_engine_multiproc(cfg: BenchConfig, input_path: str, outputs_dir: str,
     import subprocess
     import sys
 
-    # NOTE: probe-then-rebind has an inherent TOCTOU window (another
-    # process can grab the ephemeral port before the coordinator binds
-    # it); kept because jax.distributed offers no bind-then-hand-off API.
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
+    def launch_once():
+        # NOTE: probe-then-rebind has an inherent TOCTOU window (another
+        # process can grab the ephemeral port before the coordinator binds
+        # it); kept because jax.distributed offers no bind-then-hand-off
+        # API. The caller retries once on a bind failure.
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+
+        argv0 = [sys.executable, "-m", "dmlp_tpu.distributed",
+                 "--input", input_path,
+                 "--coordinator", f"localhost:{port}",
+                 "--processes", str(cfg.procs), "--warmup"]
+        argv0 += _engine_flags(cfg, cfg.mode)
+        procs = [subprocess.Popen(argv0 + ["--process-id", str(pid)],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE, env=env)
+                 for pid in range(cfg.procs)]
+        # Drain every process concurrently under ONE cluster deadline:
+        # sequential communicate(timeout) would leave later processes' pipes
+        # undrained (a stalled collective once ~64 KiB of Gloo/JAX stderr
+        # backs up) and would multiply the worst-case wall clock by N.
+        with cf.ThreadPoolExecutor(len(procs)) as ex:
+            futs = [ex.submit(p.communicate) for p in procs]
+            done, pending = cf.wait(futs, timeout=timeout_s)
+            if pending:
+                for proc in procs:
+                    proc.kill()
+                outs = [f.result() for f in futs]  # drains after the kills
+                raise EngineTimeout(
+                    f"{cfg.procs}-process cluster exceeded {timeout_s:.0f}s "
+                    f"timeout (killed), cf. mpirun --timeout at "
+                    f"run_bench.sh:82")
+            outs = [f.result() for f in futs]
+        for pid, proc in enumerate(procs):
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"process {pid} exited {proc.returncode}: "
+                    f"{outs[pid][1].decode()[-2000:]}")
+        return outs
 
     env = _config_env(cfg, env)
-    argv0 = [sys.executable, "-m", "dmlp_tpu.distributed",
-             "--input", input_path,
-             "--coordinator", f"localhost:{port}",
-             "--processes", str(cfg.procs), "--warmup"]
-    argv0 += _engine_flags(cfg, cfg.mode)
-    procs = [subprocess.Popen(argv0 + ["--process-id", str(pid)],
-                              stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                              env=env)
-             for pid in range(cfg.procs)]
-    # Drain every process concurrently under ONE cluster deadline:
-    # sequential communicate(timeout) would leave later processes' pipes
-    # undrained (a stalled collective once ~64 KiB of Gloo/JAX stderr
-    # backs up) and would multiply the worst-case wall clock by N.
-    with cf.ThreadPoolExecutor(len(procs)) as ex:
-        futs = [ex.submit(p.communicate) for p in procs]
-        done, pending = cf.wait(futs, timeout=timeout_s)
-        if pending:
-            for proc in procs:
-                proc.kill()
-            outs = [f.result() for f in futs]  # drains after the kills
-            raise EngineTimeout(
-                f"{cfg.procs}-process cluster exceeded {timeout_s:.0f}s "
-                f"timeout (killed), cf. mpirun --timeout at run_bench.sh:82")
-        outs = [f.result() for f in futs]
-    for pid, proc in enumerate(procs):
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"process {pid} exited {proc.returncode}: "
-                f"{outs[pid][1].decode()[-2000:]}")
+    try:
+        outs = launch_once()
+    except RuntimeError as e:
+        # One retry with a fresh port when the TOCTOU race above landed
+        # (the coordinator loses its probed port to another process).
+        if not isinstance(e, EngineTimeout) \
+                and "ddress already in use" in str(e):
+            outs = launch_once()
+        else:
+            raise
     tmp_out = os.path.join(outputs_dir, "tmp.out")
     tmp_err = os.path.join(outputs_dir, "tmp.err")
     with open(tmp_out, "wb") as f:

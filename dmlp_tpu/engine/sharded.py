@@ -298,14 +298,16 @@ class ShardedEngine:
         key = ("full", k, data_block, select, num_labels)
         if key not in self._fns:
             merge = self._merge_strategy
-            use_pallas = self.config.use_pallas
+            solve_shard = self._solve_shard_fn(k, data_block, select)
 
             def local(data_a, data_l, data_i, q_attrs, ks):
                 from dmlp_tpu.ops.vote import majority_vote, report_order
 
-                top = streaming_topk(q_attrs, data_a, data_l, data_i,
-                                     k=k, data_block=data_block,
-                                     select=select, use_pallas=use_pallas)
+                # The extraction kernel's per-shard lists are unsorted;
+                # both merges re-select with the composite sort (the
+                # 1-member-axis ring case included), so report_order's
+                # selection-order precondition holds either way.
+                top = solve_shard(data_a, data_l, data_i, q_attrs)
                 if merge == "allgather":
                     top = allgather_merge_topk(top, k, DATA_AXIS)
                 else:
@@ -328,23 +330,12 @@ class ShardedEngine:
     def run_device_full(self, inp: KNNInput) -> List[QueryResult]:
         """All-device pipeline over the mesh (vote + report order on the
         chips, f32 ordering; benchmark path — no float64 rescue)."""
-        cfg = self.config
         n = inp.params.num_data
-        r, c = self.mesh.devices.shape
-        shard_rows_est = round_up(max(-(-n // r), 1), 8)
-        select = cfg.resolve_streaming_select(shard_rows_est)
-        if cfg.data_block is not None:
-            data_block = min(cfg.data_block, shard_rows_est)
-        else:
-            data_block = fit_blocks(max(-(-n // r), 1),
-                                    cfg.resolve_data_block(select),
-                                    granule=cfg.resolve_granule(select))
-        d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(inp, data_block)
+        select, data_block, qgran, k = self._plan_local(inp)
+        d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(
+            inp, data_block, qgran)
         nq = inp.params.num_queries
         qpad = q_attrs.shape[0]
-        kmax = int(inp.ks.max()) if nq else 1
-        shard_rows = d_attrs.shape[0] // r
-        k = resolve_kcap(cfg, kmax, select, shard_rows * r)
         num_labels = int(inp.labels.max()) + 1 if n else 1
         self._last_select = select
 

@@ -88,8 +88,10 @@ def fused_dist_segmin(q_attrs: jax.Array, d_attrs: jax.Array,
     """
     qb, a = q_attrs.shape
     b = d_attrs.shape[0]
-    assert supports(qb, b, a), f"untileable shape (qb={qb}, b={b}, a={a});" \
-        " gate on supports() first"
+    if not supports(qb, b, a):
+        # ValueError, not assert: must fail loudly under ``python -O`` too.
+        raise ValueError(f"untileable shape (qb={qb}, b={b}, a={a}); "
+                         "gate on supports() first")
     tq = _tile(qb, _TQ, SEG)
     tn = _tile(b, _TN, 8 * SEG)
 

@@ -200,9 +200,12 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
     # the fresh-seed slice and quarter layout need kc <= tn, and the
     # distance scratch + double-buffered blocks must fit VMEM.
     vmem = (tq * tn + 2 * (tq + tn) * a + 4 * tq * kc) * 4
-    assert (qb % 8 == 0 and b % (128 * ne) == 0 and kc <= tn
-            and kc <= 512 and vmem <= 64 * 2**20), \
-        f"untileable (qb={qb}, b={b}, kc={kc}, tq={tq}, tn={tn}, ne={ne})"
+    if not (qb % 8 == 0 and b % (128 * ne) == 0 and kc <= tn
+            and kc <= 512 and vmem <= 64 * 2**20):
+        # ValueError, not assert: a caller that skipped supports() must
+        # fail loudly under ``python -O`` too, not compute garbage.
+        raise ValueError(
+            f"untileable (qb={qb}, b={b}, kc={kc}, tq={tq}, tn={tn}, ne={ne})")
 
     q32 = q_attrs.astype(jnp.float32)
     d32 = d_attrs.astype(jnp.float32)

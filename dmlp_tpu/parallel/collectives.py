@@ -48,7 +48,10 @@ def ring_allreduce_topk(local: TopK, k: int, axis_name: str) -> TopK:
     """
     n = jax.lax.axis_size(axis_name)
     if n == 1:
-        return local
+        # Still re-select: both merges promise selection-ordered output,
+        # and the extraction kernel's per-shard lists arrive UNSORTED —
+        # downstream tie-hazard checks and report_order read positions.
+        return select_topk(local.dists, local.labels, local.ids, k)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(acc: TopK, _):
