@@ -64,7 +64,13 @@ def time_baseline_ms(inp, k: int, sample_queries: int = 1024,
     t0 = time.perf_counter()
     for q0 in range(0, qs, block):
         qb = q[q0:q0 + block]
-        dist = (qb * qb).sum(axis=1)[:, None] + dn[None, :] - 2.0 * (qb @ d.T)
+        # In-place epilogue: the broadcast form's (b, N) temporaries cost
+        # ~10x the sgemm at this shape (see golden.fast) — the baseline
+        # should be the best honest CPU implementation, not a strawman.
+        dist = qb @ d.T
+        dist *= -2.0
+        dist += (qb * qb).sum(axis=1)[:, None]
+        dist += dn[None, :]
         idx = np.argpartition(dist, kth=min(k, dist.shape[1] - 1), axis=1)[:, :k]
         lab = inp.labels[idx]
         counts = np.zeros((lab.shape[0], num_labels), np.int64)

@@ -50,7 +50,8 @@ class ShardedEngine:
         self.config = config
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh_shape)
         self._dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
-        self._fns: Dict[Tuple[int, int, str], object] = {}  # (k, block, select)
+        self._fns: Dict[Tuple, object] = {}  # compiled-program cache
+        self.last_phase_ms: Dict[str, float] = {}
 
     # -- sharded placement ---------------------------------------------------
     def _shard_inputs(self, inp: KNNInput, data_block: int, qgran: int = 8):
@@ -170,16 +171,180 @@ class ShardedEngine:
         return select, data_block, 8, resolve_kcap(cfg, kmax, select,
                                                    shard_rows * r)
 
-    def candidates(self, inp: KNNInput):
-        r = self.mesh.devices.shape[0]
-        select, data_block, qgran, k = self._plan_local(inp)
-        d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(
-            inp, data_block, qgran)
+    # -- pipelined chunked staging (VERDICT r3 item 1) -----------------------
+    def _chunk_fold_fn(self, k: int, interpret: bool):
+        """Per-chunk fold program: every (row, col) cell folds its slice of
+        the staged chunk into its running (qloc, K) lists with the
+        extraction kernel. ``sc = [n, toff, shard_rows]`` rides as traced
+        scalars (the kernel takes them in SMEM), so ONE compiled program
+        serves every chunk of every input at the same shapes."""
+        key = ("chunkfold", k, interpret)
+        if key not in self._fns:
+            from dmlp_tpu.ops.pallas_extract import extract_topk
 
-        self._last_select = select  # run() gates the tie-overflow repair
-        top = self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
-                                              q_attrs)
+            def local(cd, ci, chunk_a, q_attrs, sc):
+                rr = jax.lax.axis_index(DATA_AXIS)
+                ck = chunk_a.shape[0]
+                id_base = rr * sc[2] + sc[1]
+                # Cap real rows at BOTH the dataset end and this shard's
+                # boundary: plan_chunks may overshoot (nchunks * chunk_rows
+                # > shard_rows), and an uncapped tail would re-fold the
+                # next shard's first rows — duplicate candidates after the
+                # merge.
+                n_real = jnp.clip(jnp.minimum(sc[0] - id_base,
+                                              sc[2] - sc[1]), 0, ck)
+                od, oi, _ = extract_topk(q_attrs, chunk_a, cd[0], ci[0],
+                                         n_real=n_real, id_base=id_base,
+                                         kc=k, interpret=interpret)
+                return od[None], oi[None]
+
+            self._fns[key] = jax.jit(jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, QUERY_AXIS, None),
+                          P(DATA_AXIS, QUERY_AXIS, None),
+                          P(DATA_AXIS, None), P(QUERY_AXIS, None), P()),
+                out_specs=(P(DATA_AXIS, QUERY_AXIS, None),
+                           P(DATA_AXIS, QUERY_AXIS, None)),
+                check_vma=False))
+        return self._fns[key]
+
+    def _chunk_init_fn(self, r: int, qpad: int, k: int):
+        key = ("chunkinit", r, qpad, k)
+        if key not in self._fns:
+            csh3 = NamedSharding(self.mesh, P(DATA_AXIS, QUERY_AXIS, None))
+            self._fns[key] = jax.jit(
+                lambda: (jnp.full((r, qpad, k), jnp.inf, jnp.float32),
+                         jnp.full((r, qpad, k), -1, jnp.int32)),
+                out_shardings=(csh3, csh3))
+        return self._fns[key]
+
+    def _chunk_merge_fn(self, k: int):
+        """Cross-shard merge epilogue for the chunked driver: resolve
+        labels from the replicated (tiny) labels array, then the engine's
+        merge collective — which re-selects with the composite sort, so
+        the kernel's unsorted lists come out selection-ordered."""
+        key = ("chunkmerge", k, self._merge_strategy)
+        if key not in self._fns:
+            merge = self._merge_strategy
+
+            def local(cd, ci, lab_g):
+                ids = ci[0]
+                nl = lab_g.shape[0]
+                labels = jnp.where(
+                    ids >= 0, lab_g[jnp.clip(ids, 0, max(nl - 1, 0))], -1)
+                top = TopK(cd[0], labels, ids)
+                if merge == "allgather":
+                    return allgather_merge_topk(top, k, DATA_AXIS)
+                return ring_allreduce_topk(top, k, DATA_AXIS)
+
+            self._fns[key] = jax.jit(jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, QUERY_AXIS, None),
+                          P(DATA_AXIS, QUERY_AXIS, None), P()),
+                out_specs=P(QUERY_AXIS, None),
+                check_vma=False))
+        return self._fns[key]
+
+    def _solve_chunked_extract(self, inp: KNNInput):
+        """Chunked staging + per-chunk extract folds over the mesh.
+
+        The r3 mesh engines staged the full padded dataset in ONE
+        device_put — on a transfer-bound link the end-to-end paid full
+        staging serially, while the single-chip driver overlapped chunk
+        i+1's transfer with chunk i's fold (engine.single._solve_extract).
+        This driver brings that overlap to the mesh: each shard's row
+        range is cut into the same ~chunk_rows pieces, chunk t carries
+        every shard's t-th piece (one (R*chunk_rows, A) device_put sharded
+        P("data", None)), and one fold dispatch per chunk keeps the
+        running (R, Qpad, K) lists resident across the sweep — the
+        reference's scatter phasing (engine.cpp:62-131 -> :233-257),
+        overlapped instead of serialized. Global ids stay affine per
+        (shard, chunk): id = rr * shard_rows + toff + j, which is exactly
+        the extraction kernel's id contract. Returns None when the plan
+        doesn't select the extraction kernel (caller falls back to the
+        monolithic staging paths).
+        """
+        import time as _time
+
+        from dmlp_tpu.engine.single import plan_chunks
+        from dmlp_tpu.ops.pallas_distance import native_pallas_backend
+        from dmlp_tpu.ops.pallas_extract import QUERY_TILE
+        from dmlp_tpu.ops.pallas_extract import supports as ex_supports
+
+        cfg = self.config
+        n = inp.params.num_data
         nq = inp.params.num_queries
+        na = inp.params.num_attrs
+        r, c = self.mesh.devices.shape
+        if n == 0 or nq == 0:
+            return None
+        if cfg.resolve_select(round_up(max(-(-n // r), 1), 8)) != "extract":
+            return None
+        granule = cfg.resolve_granule("extract")
+        # data_block serves as the chunk-size hint, like the single-chip
+        # extract driver (granule still rounds it to whole kernel blocks).
+        shard_rows, nchunks, chunk_rows = plan_chunks(
+            max(-(-n // r), 1), granule, cfg.data_block)
+        qloc = round_up(max(-(-nq // c), 1), QUERY_TILE)
+        qpad = c * qloc
+        kmax = int(inp.ks.max())
+        k = resolve_kcap(cfg, kmax, "extract", r * shard_rows)
+        if not ex_supports(qloc, chunk_rows, na, k):
+            return None
+        interpret = not native_pallas_backend()
+        self._last_select = "extract"
+
+        t0 = _time.perf_counter()
+        import ml_dtypes
+        np_dtype = (ml_dtypes.bfloat16 if self._dtype == jnp.bfloat16
+                    else np.float32)
+        qsh = NamedSharding(self.mesh, P(QUERY_AXIS, None))
+        csh = NamedSharding(self.mesh, P(DATA_AXIS, None))
+        rsh = NamedSharding(self.mesh, P())
+        q_attrs = np.zeros((qpad, na), np.float32)
+        q_attrs[:nq] = inp.query_attrs
+        q_dev = jax.device_put(q_attrs.astype(np_dtype, copy=False), qsh)
+        lab_dev = jax.device_put(
+            np.ascontiguousarray(inp.labels, np.int32), rsh)
+
+        cd, ci = self._chunk_init_fn(r, qpad, k)()
+        step = self._chunk_fold_fn(k, interpret)
+        src = np.ascontiguousarray(inp.data_attrs, np.float32)
+        for t in range(nchunks):
+            toff = t * chunk_rows
+            # Staging buffer directly in the wire dtype: slice assignment
+            # converts in place (one pass), instead of f32-zeros + a full
+            # astype copy per chunk.
+            a = np.zeros((r * chunk_rows, na), np_dtype)
+            for rr in range(r):
+                lo = rr * shard_rows + toff
+                # Cap at the shard boundary too (see _chunk_fold_fn): the
+                # rows past it belong to — and are staged by — shard rr+1.
+                hi = min(lo + chunk_rows, (rr + 1) * shard_rows, n)
+                if hi > lo:
+                    a[rr * chunk_rows: rr * chunk_rows + (hi - lo)] = \
+                        src[lo:hi]
+            a_dev = jax.device_put(a, csh)
+            sc = jax.device_put(
+                np.asarray([n, toff, shard_rows], np.int32), rsh)
+            cd, ci = step(cd, ci, a_dev, q_dev, sc)
+        self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
+
+        return self._chunk_merge_fn(k)(cd, ci, lab_dev), qpad
+
+    def candidates(self, inp: KNNInput):
+        nq = inp.params.num_queries
+        self.last_phase_ms = {}  # no stale phases if a path is skipped
+        out = self._solve_chunked_extract(inp)
+        if out is not None:
+            top, _ = out
+        else:
+            select, data_block, qgran, k = self._plan_local(inp)
+            d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(
+                inp, data_block, qgran)
+            self._last_select = select  # run() gates the tie-overflow repair
+            top = self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
+                                                  q_attrs)
         return (np.asarray(top.dists, np.float64)[:nq],
                 np.asarray(top.labels)[:nq],
                 np.asarray(top.ids)[:nq])
@@ -331,21 +496,35 @@ class ShardedEngine:
         """All-device pipeline over the mesh (vote + report order on the
         chips, f32 ordering; benchmark path — no float64 rescue)."""
         n = inp.params.num_data
-        select, data_block, qgran, k = self._plan_local(inp)
-        d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(
-            inp, data_block, qgran)
         nq = inp.params.num_queries
-        qpad = q_attrs.shape[0]
         num_labels = int(inp.labels.max()) + 1 if n else 1
-        self._last_select = select
-
-        ks_pad = np.zeros(qpad, np.int32)
-        ks_pad[:nq] = inp.ks
         ksh = NamedSharding(self.mesh, P(QUERY_AXIS))
-        ks_dev = jax.device_put(jnp.asarray(ks_pad), ksh)
 
-        p, i, d = self._fn_full(k, data_block, select, num_labels)(
-            d_attrs, d_labels, d_ids, q_attrs, ks_dev)
+        self.last_phase_ms = {}  # no stale phases if a path is skipped
+        out = self._solve_chunked_extract(inp)
+        if out is not None:
+            from dmlp_tpu.engine.single import _device_epilogue
+            top, qpad = out
+            ks_pad = np.zeros(qpad, np.int32)
+            ks_pad[:nq] = inp.ks
+            # Plain jit: inputs arrive query-sharded and XLA partitions
+            # the (Q, K)-local vote/report accordingly.
+            p, i, d = _device_epilogue(
+                top, jax.device_put(jnp.asarray(ks_pad), ksh),
+                num_labels=num_labels)
+        else:
+            select, data_block, qgran, k = self._plan_local(inp)
+            d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(
+                inp, data_block, qgran)
+            qpad = q_attrs.shape[0]
+            self._last_select = select
+
+            ks_pad = np.zeros(qpad, np.int32)
+            ks_pad[:nq] = inp.ks
+            ks_dev = jax.device_put(jnp.asarray(ks_pad), ksh)
+
+            p, i, d = self._fn_full(k, data_block, select, num_labels)(
+                d_attrs, d_labels, d_ids, q_attrs, ks_dev)
         preds = np.asarray(p)[:nq]
         rids = np.asarray(i)[:nq]
         rd = np.asarray(d, np.float64)[:nq]

@@ -350,6 +350,7 @@ class SingleChipEngine:
         return top, qpad
 
     def _solve(self, inp: KNNInput) -> Tuple[TopK, int]:
+        self.last_phase_ms = {}  # no stale phases if a path is skipped
         select = self.config.resolve_select(
             round_up(max(inp.params.num_data, 1), 8))
         if select == "sort":

@@ -23,6 +23,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from dmlp_tpu.engine.finalize import finalize_host
 from dmlp_tpu.golden.reference import finalize_query
 from dmlp_tpu.io.grammar import KNNInput
 from dmlp_tpu.io.report import QueryResult
@@ -62,7 +63,13 @@ def knn_golden_fast(inp: KNNInput, margin: int = 64,
         q1 = min(q0 + query_block, nq)
         q = inp.query_attrs[q0:q1].astype(np.float64)
         qn = np.einsum("qa,qa->q", q, q)
-        coarse = qn[:, None] + dn[None, :] - 2.0 * (q @ data.T)
+        # In-place epilogue on the dgemm output: the broadcast expression
+        # form allocates ~4 (Qb, N) f64 temporaries, which measured ~10x
+        # the dgemm itself at benchmark scale (page faults on fresh GBs).
+        coarse = q @ data.T
+        coarse *= -2.0
+        coarse += qn[:, None]
+        coarse += dn[None, :]
 
         if kcand < nd:
             cand = np.argpartition(coarse, kcand - 1, axis=1)[:, :kcand]
@@ -72,30 +79,54 @@ def knn_golden_fast(inp: KNNInput, margin: int = 64,
         diff = data[cand] - q[:, None, :]
         exact = np.einsum("qka,qka->qk", diff, diff)
 
-        coarse_cand = np.take_along_axis(coarse, cand, axis=1)
-        # The bound must cover the points the coarse pass EXCLUDED (their
-        # coarse value could be understated by up to the rounding error of
-        # the norm+matmul form), and an excluded point's |d|^2 can exceed
-        # every candidate's — so it uses the global max norm, not dn[cand]
-        # (ADVICE r1: the candidate-norm bound did not strictly prove
-        # exactness for adversarial large-norm excluded points).
-        err_q = 256.0 * eps * (qn + (dn.max() if nd else 0.0) + 1.0)
+        ks_blk = inp.ks[q0:q1].astype(np.int64)
+        if kcand < nd:
+            coarse_cand = np.take_along_axis(coarse, cand, axis=1)
+            # The bound must cover the points the coarse pass EXCLUDED
+            # (their coarse value could be understated by up to the
+            # rounding error of the norm+matmul form), and an excluded
+            # point's |d|^2 can exceed every candidate's — so it uses the
+            # global max norm, not dn[cand] (ADVICE r1: the candidate-norm
+            # bound did not strictly prove exactness for adversarial
+            # large-norm excluded points).
+            err_q = 256.0 * eps * (qn + (dn.max() if nd else 0.0) + 1.0)
+            # Safety (vectorized): the k-th exact distance must clear the
+            # coarse selection boundary by the error bound, else that
+            # query's candidates may be wrong -> strict full-row fallback.
+            kth_exact = np.take_along_axis(
+                np.sort(exact, axis=1),
+                np.minimum(ks_blk, kcand)[:, None] - 1, axis=1)[:, 0]
+            boundary = coarse_cand.max(axis=1)
+            ok = kth_exact < boundary - err_q
+        else:
+            ok = np.ones(q1 - q0, bool)
 
-        for qi in range(q0, q1):
-            row = qi - q0
-            k = int(inp.ks[qi])
-            if kcand < nd:
-                # Safety: the k-th exact distance must clear the coarse
-                # boundary by the error bound, else candidates may be wrong.
-                kth_exact = np.partition(exact[row], min(k, kcand) - 1)[
-                    min(k, kcand) - 1]
-                boundary = coarse_cand[row].max()
-                if not (kth_exact < boundary - err_q[row]):
-                    results[qi] = _strict_row(inp, qi, data, labels, ids)
-                    fallbacks += 1
-                    continue
-            results[qi] = finalize_query(exact[row], labels[cand[row]],
-                                         ids[cand[row]], k, qi)
+        # Batched finalize over the whole query block (VERDICT r3 item 6:
+        # the per-query Python finalize loop dominated oracle time at
+        # benchmark scale — 182 s on harness config 4). finalize_host is
+        # the engines' own vectorized implementation of the identical
+        # contract; oracle honesty is anchored by the strict per-query
+        # fallback below and the fast-vs-strict differential tests
+        # (tests/test_golden_fast.py), which diff this path against
+        # knn_golden's independent per-query code.
+        cand_l, cand_i, exact_f = labels[cand], cand, exact
+        if kcand < int(ks_blk.max(initial=0)):
+            # k may legally exceed num_data (sentinel padding); widen the
+            # candidate lists so finalize_host can pad with (-1, +inf).
+            padw = int(ks_blk.max()) - kcand
+            shape = (q1 - q0, padw)
+            exact_f = np.concatenate([exact, np.full(shape, np.inf)], axis=1)
+            cand_l = np.concatenate(
+                [cand_l, np.full(shape, -1, np.int64)], axis=1)
+            cand_i = np.concatenate(
+                [cand_i, np.full(shape, -1, np.int64)], axis=1)
+        blk = finalize_host(exact_f, cand_l, cand_i, ks_blk,
+                            inp.query_attrs, inp.data_attrs, exact=False,
+                            query_ids=np.arange(q0, q1, dtype=np.int64))
+        results[q0:q1] = blk
+        for row in np.nonzero(~ok)[0]:
+            results[q0 + row] = _strict_row(inp, q0 + row, data, labels, ids)
+            fallbacks += 1
     if stats is not None:
         stats["fallbacks"] = fallbacks
     return results

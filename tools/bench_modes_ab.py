@@ -1,0 +1,106 @@
+"""Interleaved A/B mode comparison: single vs sharded vs ring (one chip).
+
+VERDICT r3 item 2: the r3 BENCH_MODES table measured each mode in its own
+block, so link weather (the tunneled host link swings 2-4x) could masquerade
+as a mode difference — ring looked 2.9x slower than sharded on a 1-device
+mesh where both lower to near-identical programs. This tool measures the
+modes INTERLEAVED (A/B/C/A/B/C..., rotating the starting mode each rep) and
+reports per-mode median + spread, so slow-link intervals hit every mode
+equally.
+
+Writes BENCH_MODES_r{N}.json. Env: BENCH_REPS (default 5), BENCH_NUM_DATA /
+BENCH_NUM_QUERIES / BENCH_NUM_ATTRS / BENCH_K as in bench.py, BENCH_OUT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _env_int, make_workload  # noqa: E402
+
+
+def main() -> int:
+    import jax
+
+    from dmlp_tpu.cli import make_engine
+    from dmlp_tpu.config import EngineConfig
+    from dmlp_tpu.ops.pallas_distance import native_pallas_backend
+
+    num_data = _env_int("BENCH_NUM_DATA", 200_000)
+    num_queries = _env_int("BENCH_NUM_QUERIES", 10_000)
+    num_attrs = _env_int("BENCH_NUM_ATTRS", 64)
+    k = _env_int("BENCH_K", 32)
+    reps = _env_int("BENCH_REPS", 5)
+    out_path = os.environ.get("BENCH_OUT", "BENCH_MODES_r04.json")
+
+    inp = make_workload(num_data, num_queries, num_attrs, k)
+    use_pallas = native_pallas_backend()
+    modes = ["single", "sharded", "ring"]
+    engines = {}
+    for m in modes:
+        cfg = EngineConfig(mode=m, exact=False, query_block=16384,
+                           use_pallas=use_pallas)
+        engines[m] = make_engine(cfg)
+
+    # Warmup (compile) every mode before ANY timed rep, so compilation
+    # never lands inside a measurement.
+    compile_ms = {}
+    for m in modes:
+        t0 = time.perf_counter()
+        engines[m].run(inp)
+        compile_ms[m] = round((time.perf_counter() - t0) * 1e3, 1)
+
+    times: dict = {m: [] for m in modes}
+    for rep in range(reps):
+        order = modes[rep % len(modes):] + modes[:rep % len(modes)]
+        for m in order:
+            t0 = time.perf_counter()
+            engines[m].run(inp)
+            times[m].append(round((time.perf_counter() - t0) * 1e3, 1))
+
+    runs = []
+    for m in modes:
+        ts = np.asarray(times[m])
+        runs.append({
+            "mode": m,
+            "median_ms": float(np.median(ts)),
+            "min_ms": float(ts.min()),
+            "max_ms": float(ts.max()),
+            "times_ms": times[m],
+            "select": getattr(engines[m], "_last_select", None),
+            "phases_ms": {kk: round(v, 1) for kk, v in
+                          getattr(engines[m], "last_phase_ms", {}).items()},
+            "compile_plus_first_run_ms": compile_ms[m],
+        })
+    doc = {
+        "note": "Interleaved A/B/C reps (rotating start), per-mode median + "
+                "spread — link weather hits every mode equally (VERDICT r3 "
+                "item 2). 1-device mesh for sharded/ring unless more chips "
+                "exist; end-to-end engine.run() wall time (fast mode), "
+                "tunneled host link.",
+        "shape": {"num_data": num_data, "num_queries": num_queries,
+                  "num_attrs": num_attrs, "k": k},
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "n_devices": len(jax.devices()),
+        "interleaved_reps": reps,
+        "use_pallas": use_pallas,
+        "runs": runs,
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({m: {"median_ms": r["median_ms"],
+                          "spread": [r["min_ms"], r["max_ms"]]}
+                      for m, r in zip(modes, runs)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
