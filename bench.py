@@ -81,6 +81,46 @@ def time_baseline_ms(inp, k: int, sample_queries: int = 1024,
     return elapsed * (inp.params.num_queries / qs)
 
 
+def stage_extract_inputs(inp):
+    """Stage (queries, data, labels) padded to whole extract tiles on the
+    device, fenced. Shared by bench.py and the tools/ sweep/scale harnesses
+    so padding and staging scope can't silently diverge between artifacts."""
+    import jax.numpy as jnp
+
+    from dmlp_tpu.engine.single import round_up
+    from dmlp_tpu.ops.pallas_extract import BLOCK_ROWS, QUERY_TILE
+
+    n, a = inp.data_attrs.shape
+    nq = inp.params.num_queries
+    npad = round_up(n, BLOCK_ROWS)
+    qpad = round_up(nq, QUERY_TILE)
+    d = jnp.zeros((npad, a), jnp.float32).at[:n].set(
+        jnp.asarray(inp.data_attrs, jnp.float32))
+    q = jnp.zeros((qpad, a), jnp.float32).at[:nq].set(
+        jnp.asarray(inp.query_attrs, jnp.float32))
+    lab = jnp.asarray(inp.labels, jnp.int32)
+    float(jnp.sum(d))  # fence staging
+    return q, d, lab, npad, qpad
+
+
+def time_fenced_solve_ms(fn, q, d, repeats: int) -> float:
+    """Fenced repeat-timing of a jitted solve ``fn(q, d) -> (Q, K) dists``:
+    compile + fence, warm the eager perturbation chain (its tiny kernels
+    compile on first use — ~1.2 s over the remote-compile tunnel, the r2
+    mismeasurement), then time ``repeats`` chained dispatches bounded by a
+    dependent scalar readback (block_until_ready is unreliable over
+    tunneled PJRT links). Shared by bench.py and tools/."""
+    r = fn(q, d)
+    _ = float(r[0, 0])           # compile + fence
+    r = fn(q + 0.0 * r[0, 0], d)
+    _ = float(r[0, 0])           # warm the perturbation chain
+    t0 = time.perf_counter()
+    for _i in range(repeats):
+        r = fn(q + 0.0 * r[0, 0], d)  # chain dependency
+    _ = float(r[0, 0])
+    return (time.perf_counter() - t0) / repeats * 1e3
+
+
 def _time_extract_solve_ms(inp, repeats: int, use_pallas: bool):
     """Fenced on-chip time of the fused extraction solve (select="extract",
     ops.pallas_extract): one call over the whole padded dataset — the
@@ -89,46 +129,24 @@ def _time_extract_solve_ms(inp, repeats: int, use_pallas: bool):
     so the number is scope-comparable with the seg/topk streaming folds,
     which carry labels and merge inside the fold. None when the kernel
     can't run here."""
-    import jax
-    import jax.numpy as jnp
-
-    from dmlp_tpu.engine.single import round_up
+    from dmlp_tpu.engine.single import _extract_finalize, round_up
     from dmlp_tpu.ops.pallas_extract import extract_topk
     from dmlp_tpu.ops.pallas_extract import supports as extract_supports
-
-    from dmlp_tpu.ops.pallas_extract import BLOCK_ROWS, QUERY_TILE
 
     n, a = inp.data_attrs.shape
     nq = inp.params.num_queries
     k = round_up(int(inp.ks.max()) + 8, 8)
     # Whole extraction blocks / query tiles: awkward sizes otherwise tile
     # degenerately (see config.resolve_granule("extract")).
-    npad = round_up(n, BLOCK_ROWS)
-    qpad = round_up(nq, QUERY_TILE)
+    q, d, lab, npad, qpad = stage_extract_inputs(inp)
     if not (use_pallas and extract_supports(qpad, npad, a, k)):
         return None
-    from dmlp_tpu.engine.single import _extract_finalize
-
-    d = jnp.zeros((npad, a), jnp.float32).at[:n].set(
-        jnp.asarray(inp.data_attrs, jnp.float32))
-    q = jnp.zeros((qpad, a), jnp.float32).at[:nq].set(
-        jnp.asarray(inp.query_attrs, jnp.float32))
-    lab = jnp.asarray(inp.labels, jnp.int32)
-    float(jnp.sum(d))  # fence staging
 
     def fn(q_, d_):
         od, oi, _ = extract_topk(q_, d_, n_real=n, kc=k)
         return _extract_finalize(od, oi, lab, k=k).dists
 
-    r = fn(q, d)
-    _ = float(r[0, 0])           # compile + fence
-    r = fn(q + 0.0 * r[0, 0], d)
-    _ = float(r[0, 0])           # warm the perturbation chain (see below)
-    t0 = time.perf_counter()
-    for _i in range(repeats):
-        r = fn(q + 0.0 * r[0, 0], d)
-    _ = float(r[0, 0])
-    return round((time.perf_counter() - t0) / repeats * 1e3, 1)
+    return round(time_fenced_solve_ms(fn, q, d, repeats), 1)
 
 
 def time_device_solve_ms(inp, repeats: int, use_pallas: bool) -> dict:
@@ -215,9 +233,14 @@ def time_engine_ms(inp, mode: str, repeats: int):
     pallas_native = native_pallas_backend()
     use_pallas = os.environ.get("BENCH_PALLAS", "1") == "1" and pallas_native
     exact = os.environ.get("BENCH_EXACT", "0") == "1"
+    # BENCH_DTYPE=bfloat16 stages attrs in bf16 — halves the upload bytes
+    # that dominate the end-to-end on this link; pair with BENCH_EXACT=1
+    # for checksum parity (f64 host rescore; tie-overflow repairs are
+    # reported in path.repairs).
+    dtype = os.environ.get("BENCH_DTYPE", "float32")
     # query_block 16384 lets the pipelined driver fold every query block in
     # one dispatch per chunk (the HBM tile budget still caps the live tile).
-    cfg = EngineConfig(mode=mode, exact=exact, dtype="float32",
+    cfg = EngineConfig(mode=mode, exact=exact, dtype=dtype,
                        query_block=16384, use_pallas=use_pallas)
     engine = make_engine(cfg)
 
@@ -234,6 +257,7 @@ def time_engine_ms(inp, mode: str, repeats: int):
         "pallas_native": pallas_native,
         "exact": exact,
         "dtype": cfg.dtype,
+        "repairs": getattr(engine, "last_repairs", None),
         "phases_ms": {name: round(ms, 1) for name, ms in
                       getattr(engine, "last_phase_ms", {}).items()},
     }

@@ -444,6 +444,7 @@ class ShardedEngine:
         dists, labels, ids = self.candidates(inp)
         results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
                                 inp.data_attrs, exact=self.config.exact)
+        self.last_repairs = 0  # tie-overflow repair rate, for bench records
         if self._last_select in ("topk", "seg", "extract") \
                 and dists.shape[1] < inp.params.num_data:
             # Per-shard truncation of a tie group surfaces as the same
@@ -453,6 +454,7 @@ class ShardedEngine:
             suspects = np.nonzero(boundary_overflow(dists, inp.ks))[0]
             if suspects.size:
                 repair_boundary_overflow(results, suspects, inp)
+                self.last_repairs = int(suspects.size)
         return results
 
     def _fn_full(self, k: int, data_block: int, select: str,

@@ -418,10 +418,12 @@ class SingleChipEngine:
         t0 = _time.perf_counter()
         results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
                                 inp.data_attrs, exact=self.config.exact)
+        self.last_repairs = 0  # tie-overflow repair rate, for bench records
         if flags is not None:
             suspects = np.nonzero(flags)[0]
             if suspects.size:
                 repair_boundary_overflow(results, suspects, inp)
+                self.last_repairs = int(suspects.size)
         self.last_phase_ms["finalize"] = (_time.perf_counter() - t0) * 1e3
         return results
 

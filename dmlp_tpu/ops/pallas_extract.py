@@ -60,14 +60,34 @@ BLOCK_ROWS = _TN
 QUERY_TILE = _TQ
 
 
+def tuned_variant(kc: int) -> dict:
+    """Per-width kernel tuning, measured on v5e at 204800 x 10240 x 64
+    (SWEEP_WIDEK_r04.jsonl, fenced solve incl. the sort epilogue):
+
+    - narrow lists (kc <= 64): the r3 default (tq=128, ne=2) — 101.7 ms
+      at kc=64; ne=4 ties (101.3), tq/ne changes within noise.
+    - wide lists (kc > 64): (tq=64, ne=4) wins consistently — 139 vs
+      151 ms at kc=136, 188 vs 215 at kc=256, 306 vs 373 at kc=512
+      (-18%). Wider lists make each insert pass O(tq * kc); smaller query
+      tiles cut the max-over-rows wasted iterations and ne=4 inserts
+      4 candidates per threshold scan. ne=8 / tq=32 / unroll=2 all
+      measured worse (refinement rows in the same artifact).
+    """
+    if kc <= 64:
+        return {"tile_q": _TQ, "ne": _E, "unroll": 1}
+    return {"tile_q": 64, "ne": 4, "unroll": 1}
+
+
 def supports(qb: int, b: int, a: int, kc: int) -> bool:
-    """Shapes the kernel can tile: whole lane-width sub-blocks
-    (b % (128 * _E)), query tiles of 8, kc no wider than one block, and
-    VMEM room for the distance scratch + double-buffered q/d blocks."""
-    if qb % 8 != 0 or b % (128 * _E) != 0:
+    """Shapes the kernel can tile WITH the tuned variant for this kc:
+    whole lane-width sub-blocks (b % (128 * ne)), query tiles of 8, kc no
+    wider than one block, and VMEM room for the distance scratch +
+    double-buffered q/d blocks."""
+    v = tuned_variant(kc)
+    if qb % 8 != 0 or b % (128 * v["ne"]) != 0:
         return False
-    tn = _tile(b, _TN, 128 * _E)
-    tq = _tile(qb, _TQ, 8)
+    tn = _tile(b, _TN, 128 * v["ne"])
+    tq = _tile(qb, v["tile_q"], 8)
     if kc > tn or kc > 512:
         return False
     vmem = (tq * tn + 2 * (tq + tn) * a + 4 * tq * kc) * 4
@@ -181,17 +201,24 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
                  carry_d: jax.Array | None = None,
                  carry_i: jax.Array | None = None, *, n_real,
                  id_base=0, kc: int, interpret: bool = False,
-                 tile_q: int = _TQ, tile_n: int = _TN, ne: int = _E,
-                 unroll: int = 1):
+                 tile_q: int | None = None, tile_n: int = _TN,
+                 ne: int | None = None, unroll: int | None = None):
     """(queries (Qb, A), data (B, A)) -> (dists (Qb, kc) f32 ascending-ish
     unsorted, ids (Qb, kc) i32, iters (Qb/tq, B/tn) i32 loop counts).
     Rows >= n_real are sentinels; data row j has global id id_base + j.
     Optional carry (prior running lists, e.g. from a previous chunk) is
     folded in; without it slots pad (+inf, -1).
 
+    tile_q/ne/unroll default to the kc-tuned variant (tuned_variant);
+    pass them explicitly only to override (the sweep tool does).
+
     Gate on supports() first. Output lists are NOT sorted; callers sort by
     the composite key (ops.topk.select_topk) if order matters.
     """
+    v = tuned_variant(kc)
+    tile_q = v["tile_q"] if tile_q is None else tile_q
+    ne = v["ne"] if ne is None else ne
+    unroll = v["unroll"] if unroll is None else unroll
     qb, a = q_attrs.shape
     b = d_attrs.shape[0]
     tq = _tile(qb, tile_q, 8)

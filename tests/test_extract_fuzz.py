@@ -76,3 +76,63 @@ def test_extract_engine_fast_mode_random_dup_grids(seed):
     eng = SingleChipEngine(EngineConfig(select="extract", use_pallas=True,
                                         exact=False))
     assert_same_results(eng.run(inp), knn_golden(inp), check_dists=False)
+
+
+def test_extract_engine_k_beyond_kernel_cap_falls_back():
+    """VERDICT r3 item 4: k in the thousands is legal input
+    (generate_input.py:19 allows k up to num_data), but the extraction
+    kernel caps kc at 512 (pallas_extract.supports). The engine must
+    fall back gracefully to a streaming select — and still match the
+    float64 golden model exactly."""
+    rng = np.random.default_rng(77)
+    n, nq, na = 2000, 6, 4
+    data = rng.uniform(-30, 30, (n, na))
+    queries = rng.uniform(-30, 30, (nq, na))
+    labels = rng.integers(0, 5, n).astype(np.int32)
+    ks = np.array([700, 1, 640, 2000, 513, 512], np.int32)
+    inp = KNNInput(Params(n, nq, na), labels, data, ks, queries)
+    eng = SingleChipEngine(EngineConfig(select="extract", use_pallas=True))
+    got = eng.run(inp)
+    assert eng._last_select != "extract"  # fell back past the kc cap
+    assert_same_results(got, knn_golden(inp), check_dists=False)
+
+
+def test_sharded_extract_k_beyond_kernel_cap_falls_back():
+    """Same gate on the mesh engines: the chunked driver and the
+    monolithic extract plan must both decline kc > 512 and route to a
+    streaming per-shard select with golden parity."""
+    import jax
+    import pytest as _pytest
+
+    from dmlp_tpu.engine.sharded import ShardedEngine
+
+    if len(jax.devices()) < 8:
+        _pytest.skip("needs 8 devices")
+    rng = np.random.default_rng(78)
+    n, nq, na = 1500, 5, 3
+    data = rng.uniform(-9, 9, (n, na))
+    queries = rng.uniform(-9, 9, (nq, na))
+    labels = rng.integers(0, 4, n).astype(np.int32)
+    ks = np.array([600, 1, 1500, 520, 3], np.int32)
+    inp = KNNInput(Params(n, nq, na), labels, data, ks, queries)
+    eng = ShardedEngine(EngineConfig(mode="sharded", select="extract",
+                                     use_pallas=True))
+    got = eng.run(inp)
+    assert eng._last_select != "extract"
+    assert_same_results(got, knn_golden(inp), check_dists=False)
+
+
+def test_extract_engine_wide_k_tuned_variant():
+    """k > 64 routes to the wide-list tuned variant (tq=64, ne=4,
+    SWEEP_WIDEK_r04); parity must hold there too."""
+    rng = np.random.default_rng(79)
+    n, nq, na = 1400, 9, 5
+    data = rng.uniform(-15, 15, (n, na))
+    queries = rng.uniform(-15, 15, (nq, na))
+    labels = rng.integers(0, 6, n).astype(np.int32)
+    ks = rng.integers(100, 201, nq).astype(np.int32)
+    inp = KNNInput(Params(n, nq, na), labels, data, ks, queries)
+    eng = SingleChipEngine(EngineConfig(select="extract", use_pallas=True))
+    got = eng.run(inp)
+    assert eng._last_select == "extract"
+    assert_same_results(got, knn_golden(inp), check_dists=False)
