@@ -130,17 +130,21 @@ def _time_extract_solve_ms(inp, repeats: int, use_pallas: bool):
     which carry labels and merge inside the fold. None when the kernel
     can't run here."""
     from dmlp_tpu.engine.single import _extract_finalize, round_up
-    from dmlp_tpu.ops.pallas_extract import extract_topk
+    from dmlp_tpu.ops.pallas_extract import BLOCK_ROWS, QUERY_TILE, extract_topk
     from dmlp_tpu.ops.pallas_extract import supports as extract_supports
 
     n, a = inp.data_attrs.shape
     nq = inp.params.num_queries
     k = round_up(int(inp.ks.max()) + 8, 8)
-    # Whole extraction blocks / query tiles: awkward sizes otherwise tile
-    # degenerately (see config.resolve_granule("extract")).
-    q, d, lab, npad, qpad = stage_extract_inputs(inp)
-    if not (use_pallas and extract_supports(qpad, npad, a, k)):
+    # Gate BEFORE staging: on the tunneled link the padded upload is
+    # multi-second, not worth paying just to return None. Padding matches
+    # stage_extract_inputs (whole extraction blocks / query tiles —
+    # awkward sizes otherwise tile degenerately, config.resolve_granule).
+    if not (use_pallas
+            and extract_supports(round_up(nq, QUERY_TILE),
+                                 round_up(n, BLOCK_ROWS), a, k)):
         return None
+    q, d, lab, npad, qpad = stage_extract_inputs(inp)
 
     def fn(q_, d_):
         od, oi, _ = extract_topk(q_, d_, n_real=n, kc=k)

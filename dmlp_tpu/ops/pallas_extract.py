@@ -78,12 +78,26 @@ def tuned_variant(kc: int) -> dict:
     return {"tile_q": 64, "ne": 4, "unroll": 1}
 
 
+def _resolve_variant(kc: int, b: int) -> dict:
+    """The variant actually used for (kc, b): the kc-tuned one, unless its
+    ne-alignment can't tile this b (wide-k wants ne=4 → b % 512; a caller
+    with pre-shaped shards, e.g. the multi-host feed, may only satisfy
+    the ne=2 alignment) — then the default variant keeps kernel coverage
+    at r3 tuning rather than silently dropping to the streaming select.
+    supports() and extract_topk resolve through this same function, so
+    gate and kernel can never disagree."""
+    v = tuned_variant(kc)
+    if b % (128 * v["ne"]) != 0 and b % (128 * _E) == 0:
+        v = {"tile_q": _TQ, "ne": _E, "unroll": 1}
+    return v
+
+
 def supports(qb: int, b: int, a: int, kc: int) -> bool:
-    """Shapes the kernel can tile WITH the tuned variant for this kc:
+    """Shapes the kernel can tile WITH the variant resolved for (kc, b):
     whole lane-width sub-blocks (b % (128 * ne)), query tiles of 8, kc no
     wider than one block, and VMEM room for the distance scratch +
     double-buffered q/d blocks."""
-    v = tuned_variant(kc)
+    v = _resolve_variant(kc, b)
     if qb % 8 != 0 or b % (128 * v["ne"]) != 0:
         return False
     tn = _tile(b, _TN, 128 * v["ne"])
@@ -215,12 +229,12 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
     Gate on supports() first. Output lists are NOT sorted; callers sort by
     the composite key (ops.topk.select_topk) if order matters.
     """
-    v = tuned_variant(kc)
+    qb, a = q_attrs.shape
+    b = d_attrs.shape[0]
+    v = _resolve_variant(kc, b)
     tile_q = v["tile_q"] if tile_q is None else tile_q
     ne = v["ne"] if ne is None else ne
     unroll = v["unroll"] if unroll is None else unroll
-    qb, a = q_attrs.shape
-    b = d_attrs.shape[0]
     tq = _tile(qb, tile_q, 8)
     tn = _tile(b, tile_n, 128 * ne)
     # Validate the ACTUAL tiling (supports() only covers the defaults):

@@ -1,0 +1,109 @@
+"""bf16-staged end-to-end benchmark (VERDICT r3 item 5), interleaved A/B.
+
+The end-to-end solve on this link is transfer-bound (fetch ~3.7 s vs
+~0.1 s device solve in BENCH_r03): staging attrs in bfloat16 halves the
+upload bytes. This tool measures exact-mode (f64 host rescore -> checksum
+parity) f32-staged vs bf16-staged runs INTERLEAVED (the BENCH_MODES_r04
+methodology, so link weather hits both equally), verifies both produce
+IDENTICAL results query-for-query, and reports the bf16 tie-overflow
+repair rate (bf16's coarser distances make boundary ties more frequent).
+
+Writes BENCH_BF16_r04.json. Env: BENCH_REPS (default 5), shape knobs as
+in bench.py, BENCH_OUT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _env_int, make_workload  # noqa: E402
+
+
+def main() -> int:
+    import jax
+
+    from dmlp_tpu.config import EngineConfig
+    from dmlp_tpu.engine.single import SingleChipEngine
+    from dmlp_tpu.io.report import format_results
+    from dmlp_tpu.ops.pallas_distance import native_pallas_backend
+
+    num_data = _env_int("BENCH_NUM_DATA", 200_000)
+    num_queries = _env_int("BENCH_NUM_QUERIES", 10_000)
+    num_attrs = _env_int("BENCH_NUM_ATTRS", 64)
+    k = _env_int("BENCH_K", 32)
+    reps = _env_int("BENCH_REPS", 5)
+    out_path = os.environ.get("BENCH_OUT", "BENCH_BF16_r04.json")
+
+    inp = make_workload(num_data, num_queries, num_attrs, k)
+    use_pallas = native_pallas_backend()
+    engines = {
+        "f32": SingleChipEngine(EngineConfig(exact=True, dtype="float32",
+                                             query_block=16384,
+                                             use_pallas=use_pallas)),
+        "bf16": SingleChipEngine(EngineConfig(exact=True, dtype="bfloat16",
+                                              query_block=16384,
+                                              use_pallas=use_pallas)),
+    }
+    names = list(engines)
+
+    # Warmup (compile) + capture results for the parity check.
+    results = {}
+    for name in names:
+        results[name] = engines[name].run(inp)
+    parity = (format_results(results["f32"])
+              == format_results(results["bf16"]))
+
+    times: dict = {name: [] for name in names}
+    repairs: dict = {name: [] for name in names}
+    for rep in range(reps):
+        order = names if rep % 2 == 0 else names[::-1]
+        for name in order:
+            t0 = time.perf_counter()
+            engines[name].run(inp)
+            times[name].append(round((time.perf_counter() - t0) * 1e3, 1))
+            repairs[name].append(getattr(engines[name], "last_repairs", None))
+
+    doc = {
+        "note": "Exact-mode (f64 host rescore) end-to-end engine.run(), "
+                "f32-staged vs bf16-staged, interleaved A/B reps "
+                "(alternating order) on the tunneled link. bf16 halves the "
+                "staged attr bytes; exact rescore restores f64 ordering, "
+                "so results are identical — 'results_identical' verifies "
+                "it query-for-query. repairs = tie-overflow recomputes "
+                "per run (bf16 cuts more boundary ties).",
+        "shape": {"num_data": num_data, "num_queries": num_queries,
+                  "num_attrs": num_attrs, "k": k},
+        "platform": jax.devices()[0].platform,
+        "use_pallas": use_pallas,
+        "results_identical": bool(parity),
+        "runs": [
+            {"staging": name,
+             "median_ms": float(np.median(times[name])),
+             "min_ms": float(np.min(times[name])),
+             "max_ms": float(np.max(times[name])),
+             "times_ms": times[name],
+             "repairs": repairs[name],
+             "select": getattr(engines[name], "_last_select", None),
+             "staged_attr_mb": round(
+                 (num_data + num_queries) * num_attrs
+                 * (2 if name == "bf16" else 4) / 1e6, 1)}
+            for name in names
+        ],
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({n: {"median_ms": float(np.median(times[n])),
+                          "min_ms": float(np.min(times[n]))}
+                      for n in names} | {"identical": bool(parity)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

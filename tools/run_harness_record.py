@@ -10,17 +10,24 @@ mesh for the mesh/multi-process configs).
 from __future__ import annotations
 
 import json
+import os
 import sys
 
-from dmlp_tpu.bench.configs import BENCH_CONFIGS
-from dmlp_tpu.bench.harness import run_config
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dmlp_tpu.bench.configs import BENCH_CONFIGS  # noqa: E402
+from dmlp_tpu.bench.harness import run_config  # noqa: E402
 
 
 def main() -> int:
-    round_tag = sys.argv[1] if len(sys.argv) > 1 else "r03"
+    args = [a for a in sys.argv[1:] if a != "--force-oracle"]
+    force = "--force-oracle" in sys.argv[1:]  # re-time the oracle (e.g.
+    # after oracle-speed changes) instead of reusing the cached .err
+    round_tag = args[0] if args else "r03"
     results = []
     for cid, cfg in sorted(BENCH_CONFIGS.items()):
-        res = run_config(cid, base_dir=".", timeout_s=580.0)
+        res = run_config(cid, base_dir=".", timeout_s=580.0,
+                         force_oracle=force)
         res.update({"mode": cfg.mode, "use_pallas": cfg.use_pallas,
                     "select": cfg.select, "procs": cfg.procs,
                     "virtual_devices": cfg.virtual_devices,
