@@ -260,7 +260,7 @@ def time_engine_ms(inp, mode: str, repeats: int):
         "use_pallas": use_pallas,
         "pallas_native": pallas_native,
         "exact": exact,
-        "dtype": cfg.dtype,
+        "dtype": cfg.resolve_dtype(),
         "repairs": getattr(engine, "last_repairs", None),
         "phases_ms": {name: round(ms, 1) for name, ms in
                       getattr(engine, "last_phase_ms", {}).items()},

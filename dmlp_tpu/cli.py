@@ -92,8 +92,11 @@ def main(argv: Optional[Sequence[str]] = None,
     parser.add_argument("--data-block", type=int, default=None,
                         help="data rows per inner step (default: per-select)")
     parser.add_argument("--query-block", type=int, default=1024)
-    parser.add_argument("--dtype", default="float32",
-                        choices=["float32", "bfloat16"])
+    parser.add_argument("--dtype", default="auto",
+                        choices=["auto", "float32", "bfloat16"],
+                        help="staging/distance dtype; auto = bfloat16 on "
+                             "TPU in exact mode (results unchanged: f64 "
+                             "rescore), float32 elsewhere")
     parser.add_argument("--select", default="auto",
                         choices=["auto", "sort", "topk", "seg", "extract"],
                         help="device k-selection strategy")

@@ -32,9 +32,17 @@ class EngineConfig:
         cost grows superlinearly in the block; 65536 for "topk", which
         prefers large tiles).
       query_block: queries processed per outer step.
-      dtype: on-device distance dtype ("float32" or "bfloat16").
-        The reference computes in float64 (engine.cpp:12); TPU MXU is
-        f32/bf16, so strict-parity runs add host rescoring (``exact``).
+      dtype: on-device staging/distance dtype ("auto", "float32" or
+        "bfloat16"). The reference computes in float64 (engine.cpp:12);
+        TPU MXU is f32/bf16, so strict-parity runs add host rescoring
+        (``exact``). "auto" resolves to bfloat16 on TPU backends in exact
+        mode — staging in bf16 halves the host->device bytes that bound
+        the end-to-end solve on a transfer-limited link (measured 2.3x at
+        200k x 10k, BENCH_BF16_r04.json) while the f64 rescore + the
+        tie-overflow repair keep results identical — and to float32
+        everywhere else (CPU bf16 is emulated and slower; fast mode's
+        output IS the device ordering, so it never changes dtype
+        implicitly).
       exact: if True, rescore the top-(k+margin) candidates on host in
         float64 and re-select — restores float64 ordering (and hence
         checksum parity with the golden model) while keeping the O(Q*N*A)
@@ -70,7 +78,7 @@ class EngineConfig:
     mesh_shape: Optional[Tuple[int, int]] = None
     data_block: Optional[int] = None
     query_block: int = 1024
-    dtype: str = "float32"
+    dtype: str = "auto"
     exact: bool = True
     margin: int = 16
     select: str = "auto"
@@ -80,7 +88,7 @@ class EngineConfig:
     def __post_init__(self) -> None:
         if self.mode not in ("single", "sharded", "ring"):
             raise ValueError(f"unknown mode {self.mode!r}")
-        if self.dtype not in ("float32", "bfloat16"):
+        if self.dtype not in ("auto", "float32", "bfloat16"):
             raise ValueError(f"unsupported dtype {self.dtype!r}")
         if self.select not in ("auto", "sort", "topk", "seg", "extract"):
             raise ValueError(f"unknown select {self.select!r}")
@@ -89,6 +97,23 @@ class EngineConfig:
             raise ValueError("block sizes must be positive")
         if self.margin < 0:
             raise ValueError("margin must be >= 0")
+
+    def resolve_dtype(self) -> str:
+        """Concrete staging dtype ("float32" | "bfloat16") for this run.
+
+        Resolved at engine construction (first backend touch), not in
+        __post_init__, so building a config never initializes JAX.
+        """
+        if self.dtype != "auto":
+            return self.dtype
+        if not self.exact:
+            return "float32"
+        import jax
+        try:
+            platform = jax.devices()[0].platform
+        except Exception:
+            return "float32"
+        return "bfloat16" if platform == "tpu" else "float32"
 
     def resolve_select(self, padded_rows: int) -> str:
         """Concrete selection strategy for a dataset of ``padded_rows``."""

@@ -68,7 +68,43 @@ def rescore_f64(cand_ids: np.ndarray, query_attrs: np.ndarray,
     return out
 
 
-def boundary_overflow(device_dists: np.ndarray, ks: np.ndarray) -> np.ndarray:
+def staging_eps(last: np.ndarray, qn: np.ndarray, dn_max: float,
+                staging: str) -> np.ndarray:
+    """Per-query bound on the distance perturbation the staging dtype can
+    introduce, for the truncation-hazard test.
+
+    Rounding attrs to the staging dtype perturbs each computed distance by
+    at most (first order, Cauchy-Schwarz over the per-attr terms)
+
+        |d~ - d| <= 2 * u * sqrt(d) * sqrt(2 * (|q|^2 + |x|^2))
+
+    where u is the half-ulp relative rounding (2^-9 for bfloat16, 2^-24
+    for float32) — NOTE this error is NOT monotone across points, so two
+    points' device distances can swap even without an exact device tie;
+    an exact-equality hazard test is sound only for exact device
+    arithmetic. Comparing the k-th candidate against a potentially missed
+    point doubles the bound; the constants below fold the 2 * sqrt(2) * 2
+    together with >= 1.4x slack for the second-order term and the f32
+    accumulation rounding. ``dn_max`` (max squared data-row norm, f64)
+    bounds |x|^2 over every point, known or missed.
+    """
+    rel = 2.0 ** -6 if staging == "bfloat16" else 2.0 ** -21
+    return rel * np.sqrt(np.maximum(last, 0.0) * (qn + dn_max))
+
+
+def boundary_hazard(kth: np.ndarray, last: np.ndarray,
+                    eps: np.ndarray | float = 0.0) -> np.ndarray:
+    """The (eps-widened) truncation-hazard predicate on the two boundary
+    columns — THE single definition; boundary_overflow, the single-chip
+    engine (which fetches only these columns), and the distributed
+    rescore all evaluate this. +inf in the last slot means the candidate
+    list wasn't even full of real points — nothing can have been
+    truncated."""
+    return np.isfinite(last) & (last <= kth + eps)
+
+
+def boundary_overflow(device_dists: np.ndarray, ks: np.ndarray,
+                      eps: np.ndarray | float = 0.0) -> np.ndarray:
     """Queries whose fast-path candidate set may have truncated a tie group.
 
     The "topk" selection keeps the K smallest device distances with ties
@@ -82,9 +118,17 @@ def boundary_overflow(device_dists: np.ndarray, ks: np.ndarray) -> np.ndarray:
     host (engines call dmlp_tpu.golden on just those), so parity survives
     adversarial duplicate-heavy data on the fast path too.
 
+    ``eps`` widens the test to ``last <= kth + eps`` for staging dtypes
+    whose rounding perturbs distances non-monotonically (staging_eps): a
+    true neighbor can then sit up to eps ABOVE the k-th device distance,
+    so the list has provably captured the true top-k only when the
+    candidate horizon (last) clears the k-th distance by more than eps.
+    With eps = 0 this reduces to the exact-tie test.
+
     Args:
       device_dists: (Q, K) raw device candidate distances, selection order.
       ks: (Q,) per-query k.
+      eps: scalar or (Q,) staging-dtype perturbation bound.
 
     Returns:
       (Q,) bool mask of suspect queries.
@@ -94,9 +138,7 @@ def boundary_overflow(device_dists: np.ndarray, ks: np.ndarray) -> np.ndarray:
         return np.zeros(q, bool)
     last = device_dists[:, kcap - 1]
     kth = device_dists[np.arange(q), np.clip(np.asarray(ks) - 1, 0, kcap - 1)]
-    # +inf in the last slot means the candidate list wasn't even full of
-    # real points — nothing can have been truncated.
-    return np.isfinite(last) & (last == kth)
+    return boundary_hazard(kth, last, eps)
 
 
 def repair_boundary_overflow(results: List[QueryResult],
@@ -105,15 +147,17 @@ def repair_boundary_overflow(results: List[QueryResult],
 
     ``suspect_idx`` holds local query indices (positions in ``results`` /
     ``inp`` row order); the repaired entries keep their original query ids.
-    """
-    from dmlp_tpu.golden.reference import knn_golden
-    from dmlp_tpu.io.grammar import KNNInput, Params
 
-    sub = KNNInput(
-        Params(inp.params.num_data, len(suspect_idx), inp.params.num_attrs),
-        inp.labels, inp.data_attrs,
-        inp.ks[suspect_idx], inp.query_attrs[suspect_idx])
-    fixed_all = knn_golden(sub)
+    Repairs run through the vectorized oracle (golden.fast: BLAS coarse
+    pass + exact f64 rescore + strict fallback), not the per-query strict
+    model: staging-eps hazards can flag thousands of queries at once
+    (bf16 on dense distance distributions), and the repair must stay a
+    BLAS pass, not a Python loop over full-dataset solves.
+    """
+    from dmlp_tpu.golden.fast import knn_golden_fast
+    from dmlp_tpu.io.grammar import subset_queries
+
+    fixed_all = knn_golden_fast(subset_queries(inp, suspect_idx))
     for j, qi in enumerate(np.asarray(suspect_idx)):
         fixed = fixed_all[j]
         results[qi] = QueryResult(results[qi].query_id, fixed.k,

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlp_tpu.config import EngineConfig
 from dmlp_tpu.engine.finalize import (boundary_overflow, finalize_host,
-                                      repair_boundary_overflow)
+                                      repair_boundary_overflow, staging_eps)
 from dmlp_tpu.engine.single import (fit_blocks, pad_dataset, resolve_kcap,
                                     round_up)
 from dmlp_tpu.io.grammar import KNNInput
@@ -49,7 +49,9 @@ class ShardedEngine:
                  mesh: Optional[Mesh] = None):
         self.config = config
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh_shape)
-        self._dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+        self._staging = config.resolve_dtype()
+        self._dtype = (jnp.bfloat16 if self._staging == "bfloat16"
+                       else jnp.float32)
         self._fns: Dict[Tuple, object] = {}  # compiled-program cache
         self.last_phase_ms: Dict[str, float] = {}
 
@@ -157,7 +159,8 @@ class ShardedEngine:
                           cfg.resolve_granule("extract"))
             qb_local = round_up(max(-(-inp.params.num_queries // c), 1),
                                 QUERY_TILE)
-            k = resolve_kcap(cfg, kmax, "extract", sr * r)
+            k = resolve_kcap(cfg, kmax, "extract", sr * r,
+                             staging=self._staging)
             if ex_supports(qb_local, sr, inp.params.num_attrs, k):
                 return "extract", sr, QUERY_TILE, k
         select = cfg.resolve_streaming_select(shard_rows_est)
@@ -168,8 +171,8 @@ class ShardedEngine:
                                     cfg.resolve_data_block(select),
                                     granule=cfg.resolve_granule(select))
         shard_rows = round_up(max(-(-n // r), 1), data_block)
-        return select, data_block, 8, resolve_kcap(cfg, kmax, select,
-                                                   shard_rows * r)
+        return select, data_block, 8, resolve_kcap(
+            cfg, kmax, select, shard_rows * r, staging=self._staging)
 
     # -- pipelined chunked staging (VERDICT r3 item 1) -----------------------
     def _chunk_fold_fn(self, k: int, interpret: bool):
@@ -288,7 +291,8 @@ class ShardedEngine:
         qloc = round_up(max(-(-nq // c), 1), QUERY_TILE)
         qpad = c * qloc
         kmax = int(inp.ks.max())
-        k = resolve_kcap(cfg, kmax, "extract", r * shard_rows)
+        k = resolve_kcap(cfg, kmax, "extract", r * shard_rows,
+                         staging=self._staging)
         if not ex_supports(qloc, chunk_rows, na, k):
             return None
         interpret = not native_pallas_backend()
@@ -382,7 +386,8 @@ class ShardedEngine:
         if cfg.data_block is None \
                 and cfg.resolve_select(shard_rows) == "extract":
             from dmlp_tpu.ops.pallas_extract import supports as ex_supports
-            k = resolve_kcap(cfg, kmax, "extract", cap)
+            k = resolve_kcap(cfg, kmax, "extract", cap,
+                             staging=self._staging)
             if ex_supports(q_attrs.shape[0] // c, shard_rows,
                            d_attrs.shape[1], k):
                 self._last_select = "extract"
@@ -395,7 +400,8 @@ class ShardedEngine:
                            min(cfg.data_block or
                                cfg.resolve_data_block(select), shard_rows),
                            min(granule, shard_rows))
-        k = resolve_kcap(cfg, kmax, select, cap)
+        k = resolve_kcap(cfg, kmax, select, cap,
+                         staging=self._staging)
         self._last_select = select
         return select, data_block, k
 
@@ -447,11 +453,19 @@ class ShardedEngine:
         self.last_repairs = 0  # tie-overflow repair rate, for bench records
         if self._last_select in ("topk", "seg", "extract") \
                 and dists.shape[1] < inp.params.num_data:
-            # Per-shard truncation of a tie group surfaces as the same
-            # boundary equality on the merged lists (the tie value fills the
-            # tail), so one detector covers both engines. width >= num_data
-            # means every real point is a candidate — nothing truncated.
-            suspects = np.nonzero(boundary_overflow(dists, inp.ks))[0]
+            # Per-shard truncation surfaces on the merged lists: a point
+            # dropped by shard s has device dist > that shard's horizon,
+            # and the merged kcap-th <= any shard's kcap-th, so the same
+            # (eps-widened) boundary test covers both engines. width >=
+            # num_data means every real point is a candidate — nothing
+            # truncated. eps accounts for the staging dtype's non-monotone
+            # rounding (finalize.staging_eps; exact ties when f64-exact).
+            qn = np.einsum("qa,qa->q", inp.query_attrs, inp.query_attrs)
+            dn_max = float(np.einsum("na,na->n", inp.data_attrs,
+                                     inp.data_attrs).max())
+            eps = staging_eps(np.asarray(dists[:, -1], np.float64), qn,
+                              dn_max, self._staging)
+            suspects = np.nonzero(boundary_overflow(dists, inp.ks, eps))[0]
             if suspects.size:
                 repair_boundary_overflow(results, suspects, inp)
                 self.last_repairs = int(suspects.size)
@@ -496,7 +510,15 @@ class ShardedEngine:
 
     def run_device_full(self, inp: KNNInput) -> List[QueryResult]:
         """All-device pipeline over the mesh (vote + report order on the
-        chips, f32 ordering; benchmark path — no float64 rescue)."""
+        chips, f32 ordering; benchmark path — no float64 rescue).
+        dtype="auto" never coarsens this path (engine.single
+        .no_auto_coarsen): without the f64 rescore, the staging dtype IS
+        the output ordering."""
+        from dmlp_tpu.engine.single import no_auto_coarsen
+        with no_auto_coarsen(self):
+            return self._run_device_full(inp)
+
+    def _run_device_full(self, inp: KNNInput) -> List[QueryResult]:
         n = inp.params.num_data
         nq = inp.params.num_queries
         num_labels = int(inp.labels.max()) + 1 if n else 1

@@ -19,6 +19,7 @@ Two output paths:
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import List, Tuple
 
@@ -27,8 +28,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from dmlp_tpu.config import EngineConfig
-from dmlp_tpu.engine.finalize import finalize_host, repair_boundary_overflow
-from dmlp_tpu.io.grammar import KNNInput
+from dmlp_tpu.engine.finalize import (boundary_hazard, finalize_host,
+                                      repair_boundary_overflow, staging_eps)
+from dmlp_tpu.io.grammar import KNNInput, subset_queries
 from dmlp_tpu.io.report import QueryResult
 from dmlp_tpu.ops.topk import TopK, init_topk, make_block_step, streaming_topk
 from dmlp_tpu.ops.vote import majority_vote, report_order
@@ -73,14 +75,28 @@ def fit_blocks(n: int, target_block: int, granule: int = 8) -> int:
     return round_up(-(-n // nblocks), granule)
 
 
-def resolve_kcap(cfg: EngineConfig, kmax: int, select: str, cap: int) -> int:
+def resolve_kcap(cfg: EngineConfig, kmax: int, select: str, cap: int,
+                 staging: str = "float32") -> int:
     """Device candidate-list width: kmax + margin, rounded to 8, clamped to
     [kmax, cap]. The fast selection paths get >= 8 slack beyond kmax even
     with margin 0: the tie-overflow detector compares the k-th and last
-    candidate, which coincide without slack (degenerate all-repair)."""
+    candidate, which coincide without slack (degenerate all-repair).
+
+    bfloat16 staging deepens the margin with k (96 + k/2): its rounding
+    reorders device distances non-monotonically by up to
+    finalize.staging_eps, and the eps-aware hazard test only stays quiet
+    (no oracle-repair fallback) when the candidate horizon clears the
+    k-th distance by more than eps — deeper lists buy that clearance
+    where distances grow dense. Measured at the 200k x 10k x 64 benchmark
+    shape: a 32-slot window leaves 3453/10000 queries flagged, 64 slots
+    71, 96 slots 0 — the constant is that measurement plus headroom; the
+    (vectorized-oracle) repair stays as the sound backstop for inputs
+    whose distance density outruns it."""
     extra = cfg.margin if cfg.exact else 0
     if select in ("topk", "seg", "extract"):
         extra = max(extra, 8)
+    if staging == "bfloat16" and cfg.exact:
+        extra = max(extra, 96 + kmax // 2)
     return max(min(round_up(kmax + extra, 8), cap), kmax)
 
 
@@ -109,6 +125,40 @@ def pad_dataset(inp: KNNInput, multiple: int, dtype: np.dtype
     return attrs, labels, ids
 
 
+@contextlib.contextmanager
+def no_auto_coarsen(engine):
+    """Device-full output IS the device ordering (no f64 rescore or host
+    repair licenses a coarser dtype there), so dtype="auto" resolves to
+    float32 for the duration of a run_device_full; an EXPLICIT
+    dtype="bfloat16" is honored — the caller asked for it."""
+    if engine.config.dtype == "auto" and engine._staging == "bfloat16":
+        engine._staging, engine._dtype = "float32", jnp.float32
+        try:
+            yield
+        finally:
+            engine._staging, engine._dtype = "bfloat16", jnp.bfloat16
+    else:
+        yield
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("chunk_rows", "k", "select", "use_pallas"))
+def _outlier_fold(carry: TopK, q_attrs, battrs, labels_all, lo, n_real, *,
+                  chunk_rows, k, select, use_pallas=False) -> TopK:
+    """Fold one already-staged data chunk into the huge-k outlier queries'
+    running top-k (heterogeneous-k routing). The chunk's labels/ids are
+    derived ON DEVICE (labels by dynamic_slice of the once-staged full
+    label vector, ids from the chunk's row range) so the outlier path adds
+    zero host->device attr traffic — it rides the exact same chunk arrays
+    the extraction kernel consumes. ``lo``/``n_real`` are traced scalars:
+    one compile serves every chunk."""
+    blabels = jax.lax.dynamic_slice(labels_all, (lo,), (chunk_rows,))
+    ri = lo + jnp.arange(chunk_rows, dtype=jnp.int32)
+    bids = jnp.where(ri < n_real, ri, -1)
+    step = make_block_step(select, k, use_pallas, carry.dists.dtype)
+    return step(carry, q_attrs, battrs, blabels, bids)
+
+
 @functools.partial(jax.jit, static_argnames=("k", "select", "use_pallas"))
 def _chunk_fold(carry: TopK, q_attrs, battrs, blabels, bids, *, k, select,
                 use_pallas=False) -> TopK:
@@ -125,15 +175,16 @@ def _chunk_fold(carry: TopK, q_attrs, battrs, blabels, bids, *, k, select,
 
 
 @jax.jit
-def _device_flags(dists, ks):
-    """Per-query tie-overflow hazard flags, computed on device so the exact
-    path never reads the (Q, K) distance matrix back over the link (see
-    engine.finalize.boundary_overflow for the hazard derivation)."""
+def _boundary_cols(dists, ks):
+    """(kth, last) candidate-distance columns, stacked (2, Q) — computed on
+    device so the exact path never reads the (Q, K) distance matrix back
+    over the link. The host applies the staging-eps hazard test to these
+    two vectors (engine.finalize.boundary_overflow / staging_eps)."""
     kcap = dists.shape[1]
     last = dists[:, kcap - 1]
     kth = jnp.take_along_axis(
         dists, jnp.clip(ks[:, None] - 1, 0, kcap - 1), axis=1)[:, 0]
-    return jnp.isfinite(last) & (last == kth)
+    return jnp.stack([kth, last])
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -179,8 +230,11 @@ class SingleChipEngine:
 
     def __init__(self, config: EngineConfig = EngineConfig()):
         self.config = config
-        self._dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+        self._staging = config.resolve_dtype()
+        self._dtype = (jnp.bfloat16 if self._staging == "bfloat16"
+                       else jnp.float32)
         self.last_phase_ms: dict = {}
+        self.last_hetk = None  # (bulk, outlier) counts when routing split
 
     def _prep(self, inp: KNNInput):
         cfg = self.config
@@ -196,7 +250,8 @@ class SingleChipEngine:
                                     granule=cfg.resolve_granule(select))
         attrs, labels, ids = pad_dataset(inp, data_block, np.float32)
         kmax = int(inp.ks.max()) if inp.params.num_queries else 1
-        k = resolve_kcap(cfg, kmax, select, attrs.shape[0])
+        k = resolve_kcap(cfg, kmax, select, attrs.shape[0],
+                         staging=self._staging)
         d_attrs = jnp.asarray(attrs, self._dtype)
         self._last_select = select  # run() gates the tie-overflow repair on it
         return (d_attrs, jnp.asarray(labels), jnp.asarray(ids), k, data_block,
@@ -258,7 +313,8 @@ class SingleChipEngine:
         qpad = nqb * qsb
 
         kmax = int(inp.ks.max()) if nq else 1
-        k = resolve_kcap(cfg, kmax, select, nchunks * chunk_rows)
+        k = resolve_kcap(cfg, kmax, select, nchunks * chunk_rows,
+                         staging=self._staging)
 
         q_attrs = np.zeros((qpad, na), np.float32)
         q_attrs[:nq] = inp.query_attrs
@@ -322,7 +378,8 @@ class SingleChipEngine:
         from dmlp_tpu.ops.pallas_extract import QUERY_TILE
         qpad = round_up(nq, QUERY_TILE)
         kmax = int(inp.ks.max())
-        k = resolve_kcap(cfg, kmax, "extract", nchunks * chunk_rows)
+        k = resolve_kcap(cfg, kmax, "extract", nchunks * chunk_rows,
+                         staging=self._staging)
         if not extract_supports(qpad, chunk_rows, na, k):
             return None
         interpret = not native_pallas_backend()
@@ -363,6 +420,126 @@ class SingleChipEngine:
             # the chunk-fold driver on the best remaining path
         return self._solve_pipelined(inp)
 
+    def _plan_hetk(self, inp: KNNInput):
+        """Heterogeneous-k split plan: (bulk_idx, out_idx) or None.
+
+        k is legal up to num_data (generate_input.py:19) but the
+        extraction kernel's running lists cap at kc <= 512
+        (ops.pallas_extract.supports). Without routing, ONE huge-k query
+        pushes every query off the flagship kernel onto the streaming
+        select. The split keeps queries whose kcap fits on the kernel
+        ("bulk") and streams only the wide-k outliers — each query is
+        solved exactly once, on the best path its k admits.
+        """
+        cfg = self.config
+        nq, n = inp.params.num_queries, inp.params.num_data
+        if nq == 0 or n == 0 or not cfg.use_pallas:
+            return None
+        if cfg.select not in ("auto", "extract"):
+            return None
+        if cfg.resolve_select(round_up(n, 8)) != "extract":
+            return None
+        # Largest per-query k whose candidate width still fits the kernel's
+        # kc cap (the margin is k- and staging-dependent, resolve_kcap).
+        k_fit = next((k for k in range(512, 0, -1)
+                      if resolve_kcap(cfg, k, "extract", 1 << 30,
+                                      self._staging) <= 512), 0)
+        if k_fit == 0 or int(inp.ks.max()) <= k_fit:
+            return None      # everything fits: no routing needed
+        bulk = np.nonzero(inp.ks <= k_fit)[0]
+        out = np.nonzero(inp.ks > k_fit)[0]
+        if bulk.size == 0:
+            return None      # nothing the kernel could take
+        return bulk, out
+
+    def _solve_extract_routed(self, inp: KNNInput, plan):
+        """Split solve: extraction kernel for the bulk queries + streaming
+        fold for the huge-k outliers, sharing one staging pass.
+
+        Each data chunk is uploaded ONCE; the extract fold (bulk) and the
+        outlier fold are enqueued back-to-back on the same device array,
+        so the transfer-bound end-to-end cost stays that of the unsplit
+        extract path. Returns a segment list for run()/run_device_full,
+        or None when the bulk shape can't tile (caller falls back).
+        """
+        import time as _time
+
+        from dmlp_tpu.ops.pallas_distance import native_pallas_backend
+        from dmlp_tpu.ops.pallas_extract import QUERY_TILE, extract_topk
+        from dmlp_tpu.ops.pallas_extract import supports as extract_supports
+        from dmlp_tpu.ops.topk import streaming_fallback
+
+        bulk, outl = plan
+        cfg = self.config
+        n = inp.params.num_data
+        na = inp.params.num_attrs
+
+        granule = cfg.resolve_granule("extract")
+        t0 = _time.perf_counter()
+        npad, nchunks, chunk_rows = plan_chunks(n, granule, cfg.data_block)
+        qpad_b = round_up(len(bulk), QUERY_TILE)
+        kb = resolve_kcap(cfg, int(inp.ks[bulk].max()), "extract",
+                          nchunks * chunk_rows, staging=self._staging)
+        if not extract_supports(qpad_b, chunk_rows, na, kb):
+            return None
+        select_out = streaming_fallback(cfg.use_pallas)
+        ko = resolve_kcap(cfg, int(inp.ks[outl].max()), select_out,
+                          nchunks * chunk_rows, staging=self._staging)
+        interpret = not native_pallas_backend()
+        self._last_select = "extract"
+        self.last_hetk = (int(bulk.size), int(outl.size))
+
+        qb_host = np.zeros((qpad_b, na), np.float32)
+        qb_host[:len(bulk)] = inp.query_attrs[bulk]
+        qb_dev = jnp.asarray(qb_host, self._dtype)
+        qo_pad = round_up(len(outl), 8)
+        qo_host = np.zeros((qo_pad, na), np.float32)
+        qo_host[:len(outl)] = inp.query_attrs[outl]
+        qo_dev = jnp.asarray(qo_host, self._dtype)
+        labels_pad = np.full(nchunks * chunk_rows, -1, np.int32)
+        labels_pad[:n] = inp.labels
+        labels_dev = jnp.asarray(labels_pad)
+
+        carry_o = init_topk(qo_pad, ko)
+        src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
+        od = oi = None
+        for c in range(nchunks):
+            lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
+            if lo >= n:
+                break
+            a = np.zeros((chunk_rows, na), np.float32)
+            if hi > lo:
+                a[:hi - lo] = src_attrs[lo:hi]
+            da = jnp.asarray(a, self._dtype)
+            od, oi, _iters = extract_topk(
+                qb_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=kb,
+                interpret=interpret)
+            carry_o = _outlier_fold(
+                carry_o, qo_dev, da, labels_dev, jnp.int32(lo),
+                jnp.int32(n), chunk_rows=chunk_rows, k=ko,
+                select=select_out, use_pallas=cfg.use_pallas)
+        self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
+
+        top_b = _extract_finalize(od, oi, jnp.asarray(inp.labels), k=kb)
+        return [(top_b, qpad_b, bulk, "extract"),
+                (carry_o, qo_pad, outl, select_out)]
+
+    def _solve_segments(self, inp: KNNInput):
+        """Solve as a list of (TopK, qpad, query_idx | None, select)
+        segments — one segment for homogeneous k, two when the
+        heterogeneous-k router splits huge-k outliers off the extraction
+        kernel's bulk. Queries in different segments are independent
+        sub-problems; run()/run_device_full merge by original index."""
+        self.last_hetk = None
+        plan = self._plan_hetk(inp)
+        if plan is not None:
+            self.last_phase_ms = {}
+            segs = self._solve_extract_routed(inp, plan)
+            if segs is not None:
+                return segs
+        top, qpad = self._solve(inp)
+        return [(top, qpad, None, self._last_select)]
+
     def candidates(self, inp: KNNInput) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Device pass: (Q, K) selection-ordered candidate lists as NumPy."""
         out, qpad = self._solve(inp)
@@ -387,45 +564,72 @@ class SingleChipEngine:
         """
         import time as _time
 
-        nq = inp.params.num_queries
         n = inp.params.num_data
-        top, qpad = self._solve(inp)
-        kcap = top.dists.shape[1]
-
-        flags_dev = None
-        if self._last_select in ("topk", "seg", "extract") and kcap < n:
-            ks_pad = np.ones(qpad, np.int32)
-            ks_pad[:nq] = inp.ks
-            flags_dev = _device_flags(top.dists, jnp.asarray(ks_pad))
-
-        t0 = _time.perf_counter()
-        # NOTE: the "fetch" phase time includes the wait for all enqueued
-        # device work (staging + solve), not just the readback bytes — the
-        # enqueue phase above is host dispatch only. Don't read this table
-        # as "readback costs X ms".
-        fetch = ([] if self.config.exact else [top.dists]) + [top.ids] \
-            + ([flags_dev] if flags_dev is not None else [])
-        fetched = list(jax.device_get(fetch))
-        dists = None if self.config.exact \
-            else np.asarray(fetched.pop(0), np.float64)[:nq]
-        ids = fetched.pop(0)[:nq]
-        flags = fetched.pop(0)[:nq] if flags_dev is not None else None
-        labels = np.where(ids >= 0,
-                          inp.labels[np.clip(ids, 0, max(n - 1, 0))], -1) \
-            if n else np.full_like(ids, -1)
-        self.last_phase_ms["fetch"] = (_time.perf_counter() - t0) * 1e3
-
-        t0 = _time.perf_counter()
-        results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
-                                inp.data_attrs, exact=self.config.exact)
+        segments = self._solve_segments(inp)
         self.last_repairs = 0  # tie-overflow repair rate, for bench records
-        if flags is not None:
-            suspects = np.nonzero(flags)[0]
-            if suspects.size:
-                repair_boundary_overflow(results, suspects, inp)
-                self.last_repairs = int(suspects.size)
-        self.last_phase_ms["finalize"] = (_time.perf_counter() - t0) * 1e3
-        return results
+        merged: List[QueryResult] = [None] * inp.params.num_queries
+        # Max squared data-row norm (f64): scales the staging-dtype
+        # perturbation bound of the hazard test — computed on first need
+        # only (an O(N*A) host pass the "sort" / kcap >= n paths never
+        # use).
+        dn_max = None
+
+        fetch_ms = final_ms = 0.0
+        for top, qpad, idx, select in segments:
+            sub = inp if idx is None else subset_queries(inp, idx)
+            nq = sub.params.num_queries
+            kcap = top.dists.shape[1]
+
+            cols_dev = None
+            if select in ("topk", "seg", "extract") and kcap < n:
+                ks_pad = np.ones(qpad, np.int32)
+                ks_pad[:nq] = sub.ks
+                cols_dev = _boundary_cols(top.dists, jnp.asarray(ks_pad))
+
+            t0 = _time.perf_counter()
+            # NOTE: the "fetch" phase time includes the wait for all
+            # enqueued device work (staging + solve), not just the readback
+            # bytes — the enqueue phase above is host dispatch only. Don't
+            # read this table as "readback costs X ms".
+            fetch = ([] if self.config.exact else [top.dists]) + [top.ids] \
+                + ([cols_dev] if cols_dev is not None else [])
+            fetched = list(jax.device_get(fetch))
+            dists = None if self.config.exact \
+                else np.asarray(fetched.pop(0), np.float64)[:nq]
+            ids = fetched.pop(0)[:nq]
+            flags = None
+            if cols_dev is not None:
+                kth, last = np.asarray(fetched.pop(0), np.float64)[:, :nq]
+                if dn_max is None:
+                    dn_max = float(np.einsum(
+                        "na,na->n", inp.data_attrs, inp.data_attrs).max()) \
+                        if n else 0.0
+                qn = np.einsum("qa,qa->q", sub.query_attrs, sub.query_attrs)
+                eps = staging_eps(last, qn, dn_max, self._staging)
+                flags = boundary_hazard(kth, last, eps)
+            labels = np.where(ids >= 0,
+                              inp.labels[np.clip(ids, 0, max(n - 1, 0))], -1) \
+                if n else np.full_like(ids, -1)
+            fetch_ms += (_time.perf_counter() - t0) * 1e3
+
+            t0 = _time.perf_counter()
+            results = finalize_host(dists, labels, ids, sub.ks,
+                                    sub.query_attrs, sub.data_attrs,
+                                    exact=self.config.exact, query_ids=idx)
+            if flags is not None:
+                suspects = np.nonzero(flags)[0]
+                if suspects.size:
+                    repair_boundary_overflow(results, suspects, sub)
+                    self.last_repairs += int(suspects.size)
+            if idx is None:
+                merged = results
+            else:
+                for local_i, orig in enumerate(idx):
+                    merged[int(orig)] = results[local_i]
+            final_ms += (_time.perf_counter() - t0) * 1e3
+        self.last_phase_ms["fetch"] = fetch_ms
+        self.last_phase_ms["finalize"] = final_ms
+        return merged
 
     def run_device_full(self, inp: KNNInput) -> List[QueryResult]:
         """All-device pipeline (vote + report order on TPU); f32 ordering.
@@ -435,18 +639,25 @@ class SingleChipEngine:
         too — then votes and report-orders on device via the epilogue jit;
         only the final (Q, K) report lists cross the link.
         """
-        nq = inp.params.num_queries
         num_labels = int(inp.labels.max()) + 1 if inp.params.num_data else 1
-        top, qpad = self._solve(inp)
-        ks_pad = np.zeros(qpad, np.int32)
-        ks_pad[:nq] = inp.ks
+        merged: List[QueryResult] = [None] * inp.params.num_queries
+        with no_auto_coarsen(self):
+            segments = self._solve_segments(inp)
+        for top, qpad, idx, _select in segments:
+            sub = inp if idx is None else subset_queries(inp, idx)
+            nq = sub.params.num_queries
+            ks_pad = np.zeros(qpad, np.int32)
+            ks_pad[:nq] = sub.ks
 
-        p, i, d = _device_epilogue(top, jnp.asarray(ks_pad),
-                                   num_labels=num_labels)
-        preds = np.asarray(p)[:nq]
-        rids = np.asarray(i)[:nq]
-        rd = np.asarray(d, np.float64)[:nq]
-        return [QueryResult(qi, int(inp.ks[qi]), int(preds[qi]),
-                            rids[qi, : int(inp.ks[qi])].astype(np.int64),
-                            rd[qi, : int(inp.ks[qi])])
-                for qi in range(nq)]
+            p, i, d = _device_epilogue(top, jnp.asarray(ks_pad),
+                                       num_labels=num_labels)
+            preds = np.asarray(p)[:nq]
+            rids = np.asarray(i)[:nq]
+            rd = np.asarray(d, np.float64)[:nq]
+            gids = np.arange(nq) if idx is None else idx
+            for qi in range(nq):
+                merged[int(gids[qi])] = QueryResult(
+                    int(gids[qi]), int(sub.ks[qi]), int(preds[qi]),
+                    rids[qi, : int(sub.ks[qi])].astype(np.int64),
+                    rd[qi, : int(sub.ks[qi])])
+        return merged

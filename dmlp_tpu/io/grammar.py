@@ -72,6 +72,15 @@ class KNNInput:
         return np.arange(self.params.num_queries, dtype=np.int32)
 
 
+def subset_queries(inp: KNNInput, idx: np.ndarray) -> KNNInput:
+    """A view of ``inp`` restricted to the query rows in ``idx`` (the data
+    side is shared, not copied). Used by the heterogeneous-k router
+    (engine.single) and the hazard repair (engine.finalize)."""
+    return KNNInput(
+        Params(inp.params.num_data, len(idx), inp.params.num_attrs),
+        inp.labels, inp.data_attrs, inp.ks[idx], inp.query_attrs[idx])
+
+
 def _strict_int(tok: str) -> int:
     """int() minus PEP 515 underscores. Python would read "1_0" as 10; the
     reference's unchecked ``ss >> val`` stops at the underscore and

@@ -28,6 +28,7 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 
+from dmlp_tpu.engine.finalize import boundary_hazard, staging_eps
 from dmlp_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS
 
 
@@ -263,15 +264,27 @@ def place_global_inputs(engine, parsed: dict):
     contract's timed region). Returns (ga, gl, gi, gq)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    import ml_dtypes
+
     mesh = engine.mesh
     npad, qpad, na = parsed["npad"], parsed["qpad"], parsed["na"]
     dsh2 = NamedSharding(mesh, P(DATA_AXIS, None))
     dsh1 = NamedSharding(mesh, P(DATA_AXIS))
     qsh = NamedSharding(mesh, P(QUERY_AXIS, None))
-    ga = build_global(dsh2, (npad, na), parsed["p_attrs"], parsed["dlo"])
+    # Stage attrs in the engine's resolved dtype: each process converts
+    # its own shard on host, so bf16 halves the per-host feed bytes (the
+    # DCN-side analog of the single-chip staging win, BENCH_BF16_r04).
+    np_dtype = (ml_dtypes.bfloat16
+                if engine.config.resolve_dtype() == "bfloat16"
+                else np.float32)
+    ga = build_global(dsh2, (npad, na),
+                      parsed["p_attrs"].astype(np_dtype, copy=False),
+                      parsed["dlo"])
     gl = build_global(dsh1, (npad,), parsed["p_labels"], parsed["dlo"])
     gi = build_global(dsh1, (npad,), parsed["p_ids"], parsed["dlo"])
-    gq = build_global(qsh, (qpad, na), parsed["q_local"], parsed["qlo"])
+    gq = build_global(qsh, (qpad, na),
+                      parsed["q_local"].astype(np_dtype, copy=False),
+                      parsed["qlo"])
     return ga, gl, gi, gq
 
 
@@ -318,7 +331,8 @@ def _exact_shard_topk(q64: np.ndarray, d64: np.ndarray, labels: np.ndarray,
     return out_d, out_l, out_i
 
 
-def rescore_local_shards(top, local, ks: np.ndarray, nq: int):
+def rescore_local_shards(top, local, ks: np.ndarray, nq: int,
+                         staging: str = "float32"):
     """Distributed float64 rescore: each process rescores the candidates of
     the data shards it owns, using only its own f64 rows.
 
@@ -369,7 +383,8 @@ def rescore_local_shards(top, local, ks: np.ndarray, nq: int):
         # against query 0 and are discarded at finalize.
         safe = np.clip(ids_blk - offset, 0, nreal - 1)
         gathered = attrs64[safe]                           # (qloc, K, A)
-        diff = gathered - q64[np.minimum(qrows, nq - 1)][:, None, :]
+        qv = q64[np.minimum(qrows, nq - 1)]                # (qloc, A)
+        diff = gathered - qv[:, None, :]
         d64 = np.einsum("qka,qka->qk", diff, diff)
         d64[ids_blk < 0] = np.inf
 
@@ -382,7 +397,18 @@ def rescore_local_shards(top, local, ks: np.ndarray, nq: int):
         sh_hi = min(sh_lo + shard_rows, nreal)
         ks_blk = np.minimum(ks[np.minimum(qrows, max(nq - 1, 0))], kcap)
         kth = f32_blk[np.arange(q1 - q0), np.clip(ks_blk - 1, 0, kcap - 1)]
-        hazard = np.isfinite(f32_blk[:, -1]) & (f32_blk[:, -1] == kth) \
+        # eps-widened truncation test (engine.finalize.staging_eps): a
+        # staging dtype with non-monotone rounding (bf16) can displace a
+        # true neighbor past the shard horizon without an exact tie. The
+        # shard's own f64 rows bound the missed point's norm — it lives
+        # in this shard by construction.
+        qn_blk = np.einsum("qa,qa->q", qv, qv)
+        dn_max_sh = (float(np.einsum("na,na->n", attrs64[sh_lo:sh_hi],
+                                     attrs64[sh_lo:sh_hi]).max())
+                     if sh_hi > sh_lo else 0.0)
+        last_blk = np.asarray(f32_blk[:, -1], np.float64)
+        eps = staging_eps(last_blk, qn_blk, dn_max_sh, staging)
+        hazard = boundary_hazard(kth, last_blk, eps) \
             & (qrows < nq) & (kcap < sh_hi - sh_lo)
         if hazard.any():
             base_ids = np.arange(offset + sh_lo, offset + sh_hi,
@@ -430,7 +456,8 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
         nq = params.num_queries
         kmax = int(ks.max()) if nq else 1
         top = engine.solve_local_shards(ga, gl, gi, gq, kmax)
-        my_d, my_l, my_i = rescore_local_shards(top, local, ks, nq)
+        my_d, my_l, my_i = rescore_local_shards(
+            top, local, ks, nq, staging=engine.config.resolve_dtype())
 
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils

@@ -91,14 +91,16 @@ def test_contract_run_single_process_matches_golden(tmp_path, capsys):
     inp = parse_input_text(text)
     want = [r.checksum() for r in knn_golden(inp)]
 
-    for select in ("sort", "topk", "seg"):
+    for select, dtype in (("sort", "auto"), ("topk", "auto"),
+                          ("seg", "auto"), ("topk", "bfloat16")):
         engine = ShardedEngine(
-            EngineConfig(mode="sharded", select=select, query_block=8),
+            EngineConfig(mode="sharded", select=select, query_block=8,
+                         dtype=dtype),
             mesh=make_mesh())
         got = distributed_contract_run(str(path), engine,
                                        out=open(os.devnull, "w"),
                                        err=open(os.devnull, "w"))
-        assert [r.checksum() for r in got] == want, select
+        assert [r.checksum() for r in got] == want, (select, dtype)
 
 
 def test_distributed_rescore_repairs_duplicate_ties(tmp_path):

@@ -73,11 +73,15 @@ def main() -> int:
     doc = {
         "note": "Exact-mode (f64 host rescore) end-to-end engine.run(), "
                 "f32-staged vs bf16-staged, interleaved A/B reps "
-                "(alternating order) on the tunneled link. bf16 halves the "
-                "staged attr bytes; exact rescore restores f64 ordering, "
-                "so results are identical — 'results_identical' verifies "
-                "it query-for-query. repairs = tie-overflow recomputes "
-                "per run (bf16 cuts more boundary ties).",
+                "(alternating order) on the tunneled link. bf16 halves "
+                "the staged attr bytes; the f64 rescore over the deep "
+                "bf16 candidate window (resolve_kcap) plus the eps-aware "
+                "truncation test (finalize.staging_eps) make the result "
+                "provably identical — 'results_identical' verifies it "
+                "query-for-query; repairs counts oracle-repair "
+                "fallbacks. The win tracks link weather: halved upload "
+                "dominates on a slow link, while on a fast one the "
+                "deeper window's wider readback offsets part of it.",
         "shape": {"num_data": num_data, "num_queries": num_queries,
                   "num_attrs": num_attrs, "k": k},
         "platform": jax.devices()[0].platform,
