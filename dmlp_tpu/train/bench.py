@@ -38,7 +38,8 @@ def train_bench() -> dict:
     from dmlp_tpu.train.sharding import batch_shardings, make_train_mesh
     from dmlp_tpu.train.step import make_optimizer, make_train_step
 
-    offload = os.environ.get("TRAIN_OFFLOAD", "0") == "1"
+    from dmlp_tpu.train.loop import resolve_offload_level
+    offload = resolve_offload_level(os.environ.get("TRAIN_OFFLOAD", "0"))
     dims = tuple(int(d) for d in
                  os.environ.get("TRAIN_DIMS", "1024,8192,8192,1024").split(","))
     # Offload streams the full f32 params+moments (1.34 GB/step at the
@@ -47,7 +48,7 @@ def train_bench() -> dict:
     # (~27% MFU ceiling on this host link, 18.7% measured). 4x the batch
     # gives the latency-hiding scheduler enough matmul to hide the
     # streams: 53.5% MFU measured on v5e — past the >= 40% north star.
-    batch = _env_int("TRAIN_BATCH", 32768 if offload else 8192)
+    batch = _env_int("TRAIN_BATCH", 32768 if offload != "none" else 8192)
     steps = _env_int("TRAIN_STEPS", 30)
     pool = _env_int("TRAIN_POOL", 4)
     dtype = os.environ.get("TRAIN_DTYPE", "bfloat16")
@@ -61,7 +62,7 @@ def train_bench() -> dict:
     optimizer = make_optimizer("sgd", 1e-2)
     state = build_sharded_state(mesh, dims, optimizer, offload=offload)
     cdtype = jnp.bfloat16 if dtype == "bfloat16" else None
-    if offload:
+    if offload != "none":
         from dmlp_tpu.train.step import make_offload_train_step
         step_fn = make_offload_train_step(optimizer, cdtype, state)
     else:

@@ -49,14 +49,18 @@ def make_train_step(optimizer: optax.GradientTransformation,
     sharding.param_shardings and batches with batch_shardings); XLA
     propagates it through grads and inserts the dp all-reduce.
 
-    Host offload (the bench_4 analog): pass the placed state (params +
-    moments living in host DRAM via build_sharded_state(offload=True)) as
-    ``offload_state``. The step streams params/moments to HBM (in-jit
-    ``device_put`` to the ``with_memory_kind("device")`` shardings) right
-    before use, and the updated values are written back to host DRAM via
-    the jit's ``out_shardings``; XLA's latency-hiding scheduler overlaps
-    the per-layer transfers with the matmuls, so HBM holds working copies
-    only for the step's duration.
+    Host offload (the bench_4 analog): pass the placed state (host-DRAM
+    leaves per build_sharded_state's offload level) as ``offload_state``.
+    The step streams host-resident leaves to HBM (in-jit ``device_put``
+    to the ``with_memory_kind("device")`` shardings) right before use,
+    and their updated values are written back to host DRAM via the jit's
+    ``out_shardings``; XLA's latency-hiding scheduler overlaps the
+    per-layer transfers with the matmuls, so HBM holds working copies
+    only for the step's duration. Mixed states work by construction:
+    an already-HBM leaf's "device" sharding equals its own, so its
+    device_put and out_sharding are no-ops — the "params" offload level
+    (moments HBM-resident, half the stream bytes) needs no special case
+    here.
 
     Runtime note: XLA:CPU's SPMD partitioner rejects host-memory stores on
     multi-device shardings ("Side-effect ops cannot be replicated"), so on

@@ -1,0 +1,120 @@
+"""Offload-policy ladder benchmark: MFU at each host-DRAM offload level.
+
+OFFLOAD_DECOMP_r04.json showed the "all" level (params + moments streamed
+both ways, ~1.34 GB/step) is bounded by the ~5 GB/s host DMA path. The
+"params" level (train.loop.resolve_offload_level) keeps moments
+HBM-resident and halves the stream bytes — this tool measures the whole
+ladder at the same shape so the capacity-vs-speed trade is a recorded
+fact, not a claim:
+
+    none    params    all        <- offload level
+    most HBM ........ least HBM
+    fastest ......... stream-bound
+
+Writes TRAINBENCH_r04_ladder.json. Env: TRAIN_DIMS, TRAIN_BATCH,
+TRAIN_STEPS, TRAIN_DTYPE, BENCH_OUT as in train.bench.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from dmlp_tpu.train.bench import _env_int
+    from dmlp_tpu.train.data import teacher_batches
+    from dmlp_tpu.train.loop import build_sharded_state
+    from dmlp_tpu.train.metrics import (peak_flops_per_chip,
+                                        throughput_metrics)
+    from dmlp_tpu.train.sharding import batch_shardings, make_train_mesh
+    from dmlp_tpu.train.step import (make_offload_train_step, make_optimizer,
+                                     make_train_step, supports_injit_offload)
+
+    dims = tuple(int(d) for d in os.environ.get(
+        "TRAIN_DIMS", "1024,8192,8192,1024").split(","))
+    batch = _env_int("TRAIN_BATCH", 32768)
+    steps = _env_int("TRAIN_STEPS", 30)
+    dtype = os.environ.get("TRAIN_DTYPE", "bfloat16")
+    out_path = os.environ.get("BENCH_OUT", "TRAINBENCH_r04_ladder.json")
+    cdtype = jnp.bfloat16 if dtype == "bfloat16" else None
+
+    mesh = make_train_mesh(None)
+    n_chips = mesh.devices.size
+    optimizer = make_optimizer("sgd", 1e-2)
+    xsh, ysh = batch_shardings(mesh)
+    data = teacher_batches(dims[0], dims[-1], batch, seed=1)
+    batches = []
+    for _ in range(4):
+        x, y = next(data)
+        batches.append((jax.device_put(x, xsh), jax.device_put(y, ysh)))
+
+    def timed(step_fn, state):
+        for i in range(3):
+            state, m = step_fn(state, *batches[i % 4])
+        jax.device_get(m["loss"])
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, m = step_fn(state, *batches[i % 4])
+        jax.device_get(m["loss"])
+        return (time.perf_counter() - t0) / steps, state
+
+    def host_bytes(state, level):
+        leaves = []
+        if level in ("params", "all"):
+            leaves += jax.tree.leaves(state["params"])
+        if level == "all":
+            leaves += jax.tree.leaves(state["opt"])
+        return sum(a.size * a.dtype.itemsize for a in leaves)
+
+    rows = []
+    for level in ("none", "params", "all"):
+        state = build_sharded_state(mesh, dims, optimizer, offload=level)
+        if level == "none":
+            step_fn = make_train_step(optimizer, cdtype)
+        else:
+            step_fn = make_offload_train_step(optimizer, cdtype, state)
+        dt, state = timed(step_fn, state)
+        tm = throughput_metrics(state["params"], batch, dt, n_chips)
+        rows.append({
+            "offload": level,
+            "step_time_ms": round(dt * 1e3, 2),
+            "mfu": round(tm["mfu"], 4),
+            "samples_per_sec_per_chip": round(
+                tm["samples_per_sec_per_chip"], 1),
+            "streamed_bytes_each_way": host_bytes(state, level),
+        })
+        print(json.dumps(rows[-1]), flush=True)
+        del state
+
+    doc = {
+        "note": "Host-DRAM offload ladder at one shape (same batch for "
+                "every level): 'params' keeps optimizer moments "
+                "HBM-resident, halving the per-step stream bytes of "
+                "'all'; the step streams exactly the host-resident "
+                "leaves (train.step.make_train_step). streamed_bytes is "
+                "the one-way host->HBM traffic per step (updates write "
+                "the same bytes back).",
+        "shape": {"dims": list(dims), "batch": batch, "steps": steps,
+                  "dtype": dtype, "n_chips": int(n_chips),
+                  "device_kind": getattr(jax.devices()[0], "device_kind",
+                                         "?")},
+        "injit_offload": bool(supports_injit_offload()),
+        "peak_tflops_per_chip": round(peak_flops_per_chip() / 1e12, 1),
+        "levels": rows,
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({"written": out_path}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
