@@ -40,6 +40,27 @@ from dmlp_tpu.parallel.collectives import allgather_merge_topk, ring_allreduce_t
 from dmlp_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS, make_mesh
 
 
+def _chunk_span(sc, ck: int):
+    """This shard's (id_base, n_real) for one staged chunk, inside a
+    shard_map cell. ``sc = [n, toff, shard_rows]``. Caps real rows at BOTH
+    the dataset end and this shard's boundary: plan_chunks may overshoot
+    (nchunks * chunk_rows > shard_rows), and an uncapped tail would
+    re-fold the next shard's first rows — duplicate candidates after the
+    merge. Shared by the extract and outlier chunk folds so the cap can
+    never desynchronize between them."""
+    rr = jax.lax.axis_index(DATA_AXIS)
+    id_base = rr * sc[2] + sc[1]
+    n_real = jnp.clip(jnp.minimum(sc[0] - id_base, sc[2] - sc[1]), 0, ck)
+    return id_base, n_real
+
+
+def _labels_for_ids(ids, lab_g):
+    """Gather labels for global ids (-1 stays -1) from the replicated
+    label vector — shared by the chunk merge and the outlier fold."""
+    nl = lab_g.shape[0]
+    return jnp.where(ids >= 0, lab_g[jnp.clip(ids, 0, max(nl - 1, 0))], -1)
+
+
 class ShardedEngine:
     """All-gather-merge engine over a 2D ("data", "query") mesh."""
 
@@ -54,6 +75,7 @@ class ShardedEngine:
                        else jnp.float32)
         self._fns: Dict[Tuple, object] = {}  # compiled-program cache
         self.last_phase_ms: Dict[str, float] = {}
+        self.last_hetk = None  # (bulk, outlier) counts when routing split
 
     # -- sharded placement ---------------------------------------------------
     def _shard_inputs(self, inp: KNNInput, data_block: int, qgran: int = 8):
@@ -186,16 +208,7 @@ class ShardedEngine:
             from dmlp_tpu.ops.pallas_extract import extract_topk
 
             def local(cd, ci, chunk_a, q_attrs, sc):
-                rr = jax.lax.axis_index(DATA_AXIS)
-                ck = chunk_a.shape[0]
-                id_base = rr * sc[2] + sc[1]
-                # Cap real rows at BOTH the dataset end and this shard's
-                # boundary: plan_chunks may overshoot (nchunks * chunk_rows
-                # > shard_rows), and an uncapped tail would re-fold the
-                # next shard's first rows — duplicate candidates after the
-                # merge.
-                n_real = jnp.clip(jnp.minimum(sc[0] - id_base,
-                                              sc[2] - sc[1]), 0, ck)
+                id_base, n_real = _chunk_span(sc, chunk_a.shape[0])
                 od, oi, _ = extract_topk(q_attrs, chunk_a, cd[0], ci[0],
                                          n_real=n_real, id_base=id_base,
                                          kc=k, interpret=interpret)
@@ -232,10 +245,7 @@ class ShardedEngine:
 
             def local(cd, ci, lab_g):
                 ids = ci[0]
-                nl = lab_g.shape[0]
-                labels = jnp.where(
-                    ids >= 0, lab_g[jnp.clip(ids, 0, max(nl - 1, 0))], -1)
-                top = TopK(cd[0], labels, ids)
+                top = TopK(cd[0], _labels_for_ids(ids, lab_g), ids)
                 if merge == "allgather":
                     return allgather_merge_topk(top, k, DATA_AXIS)
                 return ring_allreduce_topk(top, k, DATA_AXIS)
@@ -248,7 +258,76 @@ class ShardedEngine:
                 check_vma=False))
         return self._fns[key]
 
-    def _solve_chunked_extract(self, inp: KNNInput):
+    # -- heterogeneous-k outlier programs (mesh form of single's router) ----
+    def _outlier_init_fn(self, r: int, qo_pad: int, ko: int):
+        key = ("outinit", r, qo_pad, ko)
+        if key not in self._fns:
+            csh3 = NamedSharding(self.mesh, P(DATA_AXIS, QUERY_AXIS, None))
+            self._fns[key] = jax.jit(
+                lambda: (jnp.full((r, qo_pad, ko), jnp.inf, jnp.float32),
+                         jnp.full((r, qo_pad, ko), -1, jnp.int32),
+                         jnp.full((r, qo_pad, ko), -1, jnp.int32)),
+                out_shardings=(csh3, csh3, csh3))
+        return self._fns[key]
+
+    def _outlier_fold_fn(self, ko: int, select_out: str):
+        """Per-chunk streaming fold for the wide-k outlier queries, on the
+        SAME staged chunk arrays the extraction kernel consumes: each
+        (row, col) cell derives its chunk's labels/ids on device (labels
+        gathered from the replicated label vector, ids from the shard's
+        affine row range) — the outlier path adds zero host->device attr
+        traffic, exactly like engine.single._outlier_fold."""
+        key = ("outfold", ko, select_out)
+        if key not in self._fns:
+            from dmlp_tpu.ops.topk import make_block_step
+            use_pallas = self.config.use_pallas
+
+            def local(cd, cl, ci, chunk_a, qo, lab_g, sc):
+                ck = chunk_a.shape[0]
+                id_base, n_real = _chunk_span(sc, ck)
+                iota = jnp.arange(ck, dtype=jnp.int32)
+                bids = jnp.where(iota < n_real, id_base + iota, -1)
+                blabels = _labels_for_ids(bids, lab_g)
+                step = make_block_step(select_out, ko, use_pallas,
+                                       jnp.float32)
+                top = step(TopK(cd[0], cl[0], ci[0]), qo, chunk_a,
+                           blabels, bids)
+                return top.dists[None], top.labels[None], top.ids[None]
+
+            self._fns[key] = jax.jit(jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, QUERY_AXIS, None),
+                          P(DATA_AXIS, QUERY_AXIS, None),
+                          P(DATA_AXIS, QUERY_AXIS, None),
+                          P(DATA_AXIS, None), P(QUERY_AXIS, None),
+                          P(), P()),
+                out_specs=(P(DATA_AXIS, QUERY_AXIS, None),
+                           P(DATA_AXIS, QUERY_AXIS, None),
+                           P(DATA_AXIS, QUERY_AXIS, None)),
+                check_vma=False))
+        return self._fns[key]
+
+    def _outlier_merge_fn(self, ko: int):
+        key = ("outmerge", ko, self._merge_strategy)
+        if key not in self._fns:
+            merge = self._merge_strategy
+
+            def local(cd, cl, ci):
+                top = TopK(cd[0], cl[0], ci[0])
+                if merge == "allgather":
+                    return allgather_merge_topk(top, ko, DATA_AXIS)
+                return ring_allreduce_topk(top, ko, DATA_AXIS)
+
+            self._fns[key] = jax.jit(jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, QUERY_AXIS, None),
+                          P(DATA_AXIS, QUERY_AXIS, None),
+                          P(DATA_AXIS, QUERY_AXIS, None)),
+                out_specs=P(QUERY_AXIS, None),
+                check_vma=False))
+        return self._fns[key]
+
+    def _solve_chunked_extract(self, inp: KNNInput, routed: bool = True):
         """Chunked staging + per-chunk extract folds over the mesh.
 
         The r3 mesh engines staged the full padded dataset in ONE
@@ -266,13 +345,22 @@ class ShardedEngine:
         the extraction kernel's id contract. Returns None when the plan
         doesn't select the extraction kernel (caller falls back to the
         monolithic staging paths).
+
+        ``routed`` enables the heterogeneous-k split (engine.single
+        .hetk_split): wide-k outlier queries fold on the SAME staged
+        chunks via the streaming-select mesh program while the bulk stays
+        on the kernel; the return value is then a SEGMENT LIST
+        [(top, qpad, idx, select), ...] instead of a (top, qpad) pair.
+        candidates() passes routed=False (its single-tensor contract
+        cannot carry two widths).
         """
         import time as _time
 
-        from dmlp_tpu.engine.single import plan_chunks
+        from dmlp_tpu.engine.single import hetk_split, plan_chunks
         from dmlp_tpu.ops.pallas_distance import native_pallas_backend
         from dmlp_tpu.ops.pallas_extract import QUERY_TILE
         from dmlp_tpu.ops.pallas_extract import supports as ex_supports
+        from dmlp_tpu.ops.topk import streaming_fallback
 
         cfg = self.config
         n = inp.params.num_data
@@ -283,20 +371,33 @@ class ShardedEngine:
             return None
         if cfg.resolve_select(round_up(max(-(-n // r), 1), 8)) != "extract":
             return None
+
+        split = hetk_split(cfg, self._staging, inp,
+                           round_up(max(-(-n // r), 1), 8)) if routed \
+            else None
+        if split is None:
+            bulk_idx = out_idx = None
+            nqb, q_src, kmax = nq, inp.query_attrs, int(inp.ks.max())
+        else:
+            bulk_idx, out_idx = split
+            nqb, q_src = len(bulk_idx), inp.query_attrs[bulk_idx]
+            kmax = int(inp.ks[bulk_idx].max())
+
         granule = cfg.resolve_granule("extract")
         # data_block serves as the chunk-size hint, like the single-chip
         # extract driver (granule still rounds it to whole kernel blocks).
         shard_rows, nchunks, chunk_rows = plan_chunks(
             max(-(-n // r), 1), granule, cfg.data_block)
-        qloc = round_up(max(-(-nq // c), 1), QUERY_TILE)
+        qloc = round_up(max(-(-nqb // c), 1), QUERY_TILE)
         qpad = c * qloc
-        kmax = int(inp.ks.max())
         k = resolve_kcap(cfg, kmax, "extract", r * shard_rows,
                          staging=self._staging)
         if not ex_supports(qloc, chunk_rows, na, k):
             return None
         interpret = not native_pallas_backend()
         self._last_select = "extract"
+        if split is not None:
+            self.last_hetk = (int(bulk_idx.size), int(out_idx.size))
 
         t0 = _time.perf_counter()
         import ml_dtypes
@@ -306,13 +407,27 @@ class ShardedEngine:
         csh = NamedSharding(self.mesh, P(DATA_AXIS, None))
         rsh = NamedSharding(self.mesh, P())
         q_attrs = np.zeros((qpad, na), np.float32)
-        q_attrs[:nq] = inp.query_attrs
+        q_attrs[:nqb] = q_src
         q_dev = jax.device_put(q_attrs.astype(np_dtype, copy=False), qsh)
         lab_dev = jax.device_put(
             np.ascontiguousarray(inp.labels, np.int32), rsh)
 
         cd, ci = self._chunk_init_fn(r, qpad, k)()
         step = self._chunk_fold_fn(k, interpret)
+
+        ostep = None
+        if split is not None:
+            select_out = streaming_fallback(cfg.use_pallas)
+            ko = resolve_kcap(cfg, int(inp.ks[out_idx].max()), select_out,
+                              r * shard_rows, staging=self._staging)
+            qo_loc = round_up(max(-(-len(out_idx) // c), 1), 8)
+            qo_pad = c * qo_loc
+            qo = np.zeros((qo_pad, na), np.float32)
+            qo[:len(out_idx)] = inp.query_attrs[out_idx]
+            qo_dev = jax.device_put(qo.astype(np_dtype, copy=False), qsh)
+            od, ol, oi = self._outlier_init_fn(r, qo_pad, ko)()
+            ostep = self._outlier_fold_fn(ko, select_out)
+
         src = np.ascontiguousarray(inp.data_attrs, np.float32)
         for t in range(nchunks):
             toff = t * chunk_rows
@@ -332,14 +447,22 @@ class ShardedEngine:
             sc = jax.device_put(
                 np.asarray([n, toff, shard_rows], np.int32), rsh)
             cd, ci = step(cd, ci, a_dev, q_dev, sc)
+            if ostep is not None:
+                od, ol, oi = ostep(od, ol, oi, a_dev, qo_dev, lab_dev, sc)
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
-        return self._chunk_merge_fn(k)(cd, ci, lab_dev), qpad
+        top_b = self._chunk_merge_fn(k)(cd, ci, lab_dev)
+        if split is None:
+            return top_b, qpad
+        top_o = self._outlier_merge_fn(ko)(od, ol, oi)
+        return [(top_b, qpad, bulk_idx, "extract"),
+                (top_o, qo_pad, out_idx, select_out)]
 
     def candidates(self, inp: KNNInput):
         nq = inp.params.num_queries
         self.last_phase_ms = {}  # no stale phases if a path is skipped
-        out = self._solve_chunked_extract(inp)
+        self.last_hetk = None    # routed=False below: no split ever fires
+        out = self._solve_chunked_extract(inp, routed=False)
         if out is not None:
             top, _ = out
         else:
@@ -352,6 +475,27 @@ class ShardedEngine:
         return (np.asarray(top.dists, np.float64)[:nq],
                 np.asarray(top.labels)[:nq],
                 np.asarray(top.ids)[:nq])
+
+    def _solve_segments(self, inp: KNNInput):
+        """Solve as (TopK, qpad, query_idx | None, select) segments — the
+        mesh form of engine.single._solve_segments: one segment normally,
+        two when the heterogeneous-k router splits wide-k outliers off
+        the extraction kernel's bulk."""
+        self.last_hetk = None
+        self.last_phase_ms = {}
+        out = self._solve_chunked_extract(inp)
+        if isinstance(out, list):
+            return out
+        if out is not None:
+            top, qpad = out
+            return [(top, qpad, None, self._last_select)]
+        select, data_block, qgran, k = self._plan_local(inp)
+        d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(
+            inp, data_block, qgran)
+        self._last_select = select
+        top = self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
+                                              q_attrs)
+        return [(top, q_attrs.shape[0], None, select)]
 
     def solve_global(self, d_attrs, d_labels, d_ids, q_attrs, kmax: int):
         """Run the compiled sharded program on pre-placed global arrays.
@@ -447,29 +591,48 @@ class ShardedEngine:
                                                      d_ids, q_attrs)
 
     def run(self, inp: KNNInput) -> List[QueryResult]:
-        dists, labels, ids = self.candidates(inp)
-        results = finalize_host(dists, labels, ids, inp.ks, inp.query_attrs,
-                                inp.data_attrs, exact=self.config.exact)
+        from dmlp_tpu.io.grammar import subset_queries
+
+        n = inp.params.num_data
+        segments = self._solve_segments(inp)
         self.last_repairs = 0  # tie-overflow repair rate, for bench records
-        if self._last_select in ("topk", "seg", "extract") \
-                and dists.shape[1] < inp.params.num_data:
-            # Per-shard truncation surfaces on the merged lists: a point
-            # dropped by shard s has device dist > that shard's horizon,
-            # and the merged kcap-th <= any shard's kcap-th, so the same
-            # (eps-widened) boundary test covers both engines. width >=
-            # num_data means every real point is a candidate — nothing
-            # truncated. eps accounts for the staging dtype's non-monotone
-            # rounding (finalize.staging_eps; exact ties when f64-exact).
-            qn = np.einsum("qa,qa->q", inp.query_attrs, inp.query_attrs)
-            dn_max = float(np.einsum("na,na->n", inp.data_attrs,
-                                     inp.data_attrs).max())
-            eps = staging_eps(np.asarray(dists[:, -1], np.float64), qn,
-                              dn_max, self._staging)
-            suspects = np.nonzero(boundary_overflow(dists, inp.ks, eps))[0]
-            if suspects.size:
-                repair_boundary_overflow(results, suspects, inp)
-                self.last_repairs = int(suspects.size)
-        return results
+        merged: List[QueryResult] = [None] * inp.params.num_queries
+        dn_max = None
+        for top, _qpad, idx, select in segments:
+            sub = inp if idx is None else subset_queries(inp, idx)
+            nq = sub.params.num_queries
+            dists = np.asarray(top.dists, np.float64)[:nq]
+            labels = np.asarray(top.labels)[:nq]
+            ids = np.asarray(top.ids)[:nq]
+            results = finalize_host(dists, labels, ids, sub.ks,
+                                    sub.query_attrs, sub.data_attrs,
+                                    exact=self.config.exact, query_ids=idx)
+            if select in ("topk", "seg", "extract") and dists.shape[1] < n:
+                # Per-shard truncation surfaces on the merged lists: a
+                # point dropped by shard s has device dist > that shard's
+                # horizon, and the merged kcap-th <= any shard's kcap-th,
+                # so the same (eps-widened) boundary test covers both
+                # engines. width >= num_data means every real point is a
+                # candidate — nothing truncated. eps accounts for the
+                # staging dtype's non-monotone rounding
+                # (finalize.staging_eps; exact ties when f64-exact).
+                if dn_max is None:
+                    dn_max = float(np.einsum("na,na->n", inp.data_attrs,
+                                             inp.data_attrs).max())
+                qn = np.einsum("qa,qa->q", sub.query_attrs, sub.query_attrs)
+                eps = staging_eps(np.asarray(dists[:, -1], np.float64), qn,
+                                  dn_max, self._staging)
+                suspects = np.nonzero(
+                    boundary_overflow(dists, sub.ks, eps))[0]
+                if suspects.size:
+                    repair_boundary_overflow(results, suspects, sub)
+                    self.last_repairs += int(suspects.size)
+            if idx is None:
+                merged = results
+            else:
+                for local_i, orig in enumerate(idx):
+                    merged[int(orig)] = results[local_i]
+        return merged
 
     def _fn_full(self, k: int, data_block: int, select: str,
                  num_labels: int):
@@ -519,36 +682,54 @@ class ShardedEngine:
             return self._run_device_full(inp)
 
     def _run_device_full(self, inp: KNNInput) -> List[QueryResult]:
+        from dmlp_tpu.io.grammar import subset_queries
+
         n = inp.params.num_data
         nq = inp.params.num_queries
         num_labels = int(inp.labels.max()) + 1 if n else 1
         ksh = NamedSharding(self.mesh, P(QUERY_AXIS))
 
         self.last_phase_ms = {}  # no stale phases if a path is skipped
+        self.last_hetk = None
         out = self._solve_chunked_extract(inp)
         if out is not None:
             from dmlp_tpu.engine.single import _device_epilogue
-            top, qpad = out
-            ks_pad = np.zeros(qpad, np.int32)
-            ks_pad[:nq] = inp.ks
-            # Plain jit: inputs arrive query-sharded and XLA partitions
-            # the (Q, K)-local vote/report accordingly.
-            p, i, d = _device_epilogue(
-                top, jax.device_put(jnp.asarray(ks_pad), ksh),
-                num_labels=num_labels)
-        else:
-            select, data_block, qgran, k = self._plan_local(inp)
-            d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(
-                inp, data_block, qgran)
-            qpad = q_attrs.shape[0]
-            self._last_select = select
+            segments = out if isinstance(out, list) \
+                else [(out[0], out[1], None, self._last_select)]
+            merged: List[QueryResult] = [None] * nq
+            for top, qpad, idx, _select in segments:
+                sub = inp if idx is None else subset_queries(inp, idx)
+                nqs = sub.params.num_queries
+                ks_pad = np.zeros(qpad, np.int32)
+                ks_pad[:nqs] = sub.ks
+                # Plain jit: inputs arrive query-sharded and XLA
+                # partitions the (Q, K)-local vote/report accordingly.
+                p, i, d = _device_epilogue(
+                    top, jax.device_put(jnp.asarray(ks_pad), ksh),
+                    num_labels=num_labels)
+                preds = np.asarray(p)[:nqs]
+                rids = np.asarray(i)[:nqs]
+                rd = np.asarray(d, np.float64)[:nqs]
+                gids = np.arange(nqs) if idx is None else idx
+                for qi in range(nqs):
+                    merged[int(gids[qi])] = QueryResult(
+                        int(gids[qi]), int(sub.ks[qi]), int(preds[qi]),
+                        rids[qi, : int(sub.ks[qi])].astype(np.int64),
+                        rd[qi, : int(sub.ks[qi])])
+            return merged
 
-            ks_pad = np.zeros(qpad, np.int32)
-            ks_pad[:nq] = inp.ks
-            ks_dev = jax.device_put(jnp.asarray(ks_pad), ksh)
+        select, data_block, qgran, k = self._plan_local(inp)
+        d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(
+            inp, data_block, qgran)
+        qpad = q_attrs.shape[0]
+        self._last_select = select
 
-            p, i, d = self._fn_full(k, data_block, select, num_labels)(
-                d_attrs, d_labels, d_ids, q_attrs, ks_dev)
+        ks_pad = np.zeros(qpad, np.int32)
+        ks_pad[:nq] = inp.ks
+        ks_dev = jax.device_put(jnp.asarray(ks_pad), ksh)
+
+        p, i, d = self._fn_full(k, data_block, select, num_labels)(
+            d_attrs, d_labels, d_ids, q_attrs, ks_dev)
         preds = np.asarray(p)[:nq]
         rids = np.asarray(i)[:nq]
         rd = np.asarray(d, np.float64)[:nq]

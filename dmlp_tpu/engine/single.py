@@ -125,6 +125,40 @@ def pad_dataset(inp: KNNInput, multiple: int, dtype: np.dtype
     return attrs, labels, ids
 
 
+def hetk_split(cfg: EngineConfig, staging: str, inp: KNNInput,
+               gate_rows: int):
+    """Heterogeneous-k split plan: (bulk_idx, out_idx) or None.
+
+    k is legal up to num_data (generate_input.py:19) but the extraction
+    kernel's running lists cap at kc <= 512 (ops.pallas_extract.supports).
+    Without routing, ONE huge-k query pushes every query off the flagship
+    kernel onto the streaming select. The split keeps queries whose kcap
+    fits on the kernel ("bulk") and streams only the wide-k outliers —
+    each query is solved exactly once, on the best path its k admits.
+    ``gate_rows`` is the row count the auto-select gate sees (whole
+    dataset for the single-chip engine, one shard for the mesh engines).
+    """
+    nq, n = inp.params.num_queries, inp.params.num_data
+    if nq == 0 or n == 0 or not cfg.use_pallas:
+        return None
+    if cfg.select not in ("auto", "extract"):
+        return None
+    if cfg.resolve_select(gate_rows) != "extract":
+        return None
+    # Largest per-query k whose candidate width still fits the kernel's
+    # kc cap (the margin is k- and staging-dependent, resolve_kcap).
+    k_fit = next((k for k in range(512, 0, -1)
+                  if resolve_kcap(cfg, k, "extract", 1 << 30,
+                                  staging) <= 512), 0)
+    if k_fit == 0 or int(inp.ks.max()) <= k_fit:
+        return None      # everything fits: no routing needed
+    bulk = np.nonzero(inp.ks <= k_fit)[0]
+    out = np.nonzero(inp.ks > k_fit)[0]
+    if bulk.size == 0:
+        return None      # nothing the kernel could take
+    return bulk, out
+
+
 @contextlib.contextmanager
 def no_auto_coarsen(engine):
     """Device-full output IS the device ordering (no f64 rescore or host
@@ -421,36 +455,8 @@ class SingleChipEngine:
         return self._solve_pipelined(inp)
 
     def _plan_hetk(self, inp: KNNInput):
-        """Heterogeneous-k split plan: (bulk_idx, out_idx) or None.
-
-        k is legal up to num_data (generate_input.py:19) but the
-        extraction kernel's running lists cap at kc <= 512
-        (ops.pallas_extract.supports). Without routing, ONE huge-k query
-        pushes every query off the flagship kernel onto the streaming
-        select. The split keeps queries whose kcap fits on the kernel
-        ("bulk") and streams only the wide-k outliers — each query is
-        solved exactly once, on the best path its k admits.
-        """
-        cfg = self.config
-        nq, n = inp.params.num_queries, inp.params.num_data
-        if nq == 0 or n == 0 or not cfg.use_pallas:
-            return None
-        if cfg.select not in ("auto", "extract"):
-            return None
-        if cfg.resolve_select(round_up(n, 8)) != "extract":
-            return None
-        # Largest per-query k whose candidate width still fits the kernel's
-        # kc cap (the margin is k- and staging-dependent, resolve_kcap).
-        k_fit = next((k for k in range(512, 0, -1)
-                      if resolve_kcap(cfg, k, "extract", 1 << 30,
-                                      self._staging) <= 512), 0)
-        if k_fit == 0 or int(inp.ks.max()) <= k_fit:
-            return None      # everything fits: no routing needed
-        bulk = np.nonzero(inp.ks <= k_fit)[0]
-        out = np.nonzero(inp.ks > k_fit)[0]
-        if bulk.size == 0:
-            return None      # nothing the kernel could take
-        return bulk, out
+        return hetk_split(self.config, self._staging, inp,
+                          round_up(max(inp.params.num_data, 1), 8))
 
     def _solve_extract_routed(self, inp: KNNInput, plan):
         """Split solve: extraction kernel for the bulk queries + streaming

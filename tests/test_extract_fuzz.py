@@ -183,10 +183,11 @@ def test_hetk_routing_device_full_and_fast_mode():
         assert g.checksum() == w.checksum()
 
 
-def test_sharded_extract_k_beyond_kernel_cap_falls_back():
-    """Same gate on the mesh engines: the chunked driver and the
-    monolithic extract plan must both decline kc > 512 and route to a
-    streaming per-shard select with golden parity."""
+def test_sharded_extract_k_beyond_kernel_cap_routes_outliers():
+    """The mesh engines route heterogeneous k too: the chunked driver
+    keeps the extraction kernel for the bulk and folds the wide-k
+    outliers on the SAME staged chunks (streaming mesh program), with
+    golden parity on the merged results."""
     import jax
     import pytest as _pytest
 
@@ -204,8 +205,42 @@ def test_sharded_extract_k_beyond_kernel_cap_falls_back():
     eng = ShardedEngine(EngineConfig(mode="sharded", select="extract",
                                      use_pallas=True))
     got = eng.run(inp)
-    assert eng._last_select != "extract"
+    assert eng._last_select == "extract"   # bulk stayed on the kernel
+    assert eng.last_hetk == (2, 3)
     assert_same_results(got, knn_golden(inp), check_dists=False)
+
+
+def test_ring_hetk_routing_matches_golden():
+    """Ring merge strategy serves both router segments (outlier lists
+    merge by ring all-reduce too); device-full stays unrouted-compatible
+    via the same segment loop."""
+    import jax
+    import pytest as _pytest
+
+    from dmlp_tpu.engine.ring import RingEngine
+
+    if len(jax.devices()) < 8:
+        _pytest.skip("needs 8 devices")
+    rng = np.random.default_rng(81)
+    n, nq, na = 1100, 9, 4
+    data = rng.uniform(0, 50, (n, na))
+    queries = rng.uniform(0, 50, (nq, na))
+    labels = rng.integers(0, 5, n).astype(np.int32)
+    ks = rng.integers(1, 20, nq).astype(np.int32)
+    ks[4], ks[8] = 700, 1100
+    inp = KNNInput(Params(n, nq, na), labels, data, ks, queries)
+    want = knn_golden(inp)
+    eng = RingEngine(EngineConfig(mode="ring", select="extract",
+                                  use_pallas=True))
+    got = eng.run(inp)
+    assert eng.last_hetk == (7, 2)
+    assert_same_results(got, want, check_dists=False)
+
+    full = eng.run_device_full(inp)
+    assert eng.last_hetk == (7, 2)
+    for g, w in zip(full, want):
+        assert g.query_id == w.query_id
+        assert g.checksum() == w.checksum()
 
 
 def test_extract_engine_wide_k_tuned_variant():
