@@ -95,9 +95,7 @@ class ShardedEngine:
         # jnp.asarray first would land the full array on the default device
         # and reshard from there — a second full copy, and on a tunneled
         # host link a second full transfer.
-        import ml_dtypes
-        np_dtype = (ml_dtypes.bfloat16 if self._dtype == jnp.bfloat16
-                    else np.float32)
+        np_dtype = self.config.resolve_np_dtype()
         return (jax.device_put(attrs.astype(np_dtype, copy=False), dsh),
                 jax.device_put(labels, dsh1),
                 jax.device_put(ids, dsh1),
@@ -372,7 +370,7 @@ class ShardedEngine:
         if cfg.resolve_select(round_up(max(-(-n // r), 1), 8)) != "extract":
             return None
 
-        split = hetk_split(cfg, self._staging, inp,
+        split = hetk_split(cfg, self._staging, inp.ks, n,
                            round_up(max(-(-n // r), 1), 8)) if routed \
             else None
         if split is None:
@@ -400,9 +398,7 @@ class ShardedEngine:
             self.last_hetk = (int(bulk_idx.size), int(out_idx.size))
 
         t0 = _time.perf_counter()
-        import ml_dtypes
-        np_dtype = (ml_dtypes.bfloat16 if self._dtype == jnp.bfloat16
-                    else np.float32)
+        np_dtype = self.config.resolve_np_dtype()
         qsh = NamedSharding(self.mesh, P(QUERY_AXIS, None))
         csh = NamedSharding(self.mesh, P(DATA_AXIS, None))
         rsh = NamedSharding(self.mesh, P())

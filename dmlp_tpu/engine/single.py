@@ -125,8 +125,8 @@ def pad_dataset(inp: KNNInput, multiple: int, dtype: np.dtype
     return attrs, labels, ids
 
 
-def hetk_split(cfg: EngineConfig, staging: str, inp: KNNInput,
-               gate_rows: int):
+def hetk_split(cfg: EngineConfig, staging: str, ks: np.ndarray,
+               num_data: int, gate_rows: int):
     """Heterogeneous-k split plan: (bulk_idx, out_idx) or None.
 
     k is legal up to num_data (generate_input.py:19) but the extraction
@@ -138,8 +138,7 @@ def hetk_split(cfg: EngineConfig, staging: str, inp: KNNInput,
     ``gate_rows`` is the row count the auto-select gate sees (whole
     dataset for the single-chip engine, one shard for the mesh engines).
     """
-    nq, n = inp.params.num_queries, inp.params.num_data
-    if nq == 0 or n == 0 or not cfg.use_pallas:
+    if len(ks) == 0 or num_data == 0 or not cfg.use_pallas:
         return None
     if cfg.select not in ("auto", "extract"):
         return None
@@ -150,10 +149,10 @@ def hetk_split(cfg: EngineConfig, staging: str, inp: KNNInput,
     k_fit = next((k for k in range(512, 0, -1)
                   if resolve_kcap(cfg, k, "extract", 1 << 30,
                                   staging) <= 512), 0)
-    if k_fit == 0 or int(inp.ks.max()) <= k_fit:
+    if k_fit == 0 or int(ks.max()) <= k_fit:
         return None      # everything fits: no routing needed
-    bulk = np.nonzero(inp.ks <= k_fit)[0]
-    out = np.nonzero(inp.ks > k_fit)[0]
+    bulk = np.nonzero(ks <= k_fit)[0]
+    out = np.nonzero(ks > k_fit)[0]
     if bulk.size == 0:
         return None      # nothing the kernel could take
     return bulk, out
@@ -455,7 +454,8 @@ class SingleChipEngine:
         return self._solve_pipelined(inp)
 
     def _plan_hetk(self, inp: KNNInput):
-        return hetk_split(self.config, self._staging, inp,
+        return hetk_split(self.config, self._staging, inp.ks,
+                          inp.params.num_data,
                           round_up(max(inp.params.num_data, 1), 8))
 
     def _solve_extract_routed(self, inp: KNNInput, plan):

@@ -264,28 +264,35 @@ def place_global_inputs(engine, parsed: dict):
     contract's timed region). Returns (ga, gl, gi, gq)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    import ml_dtypes
+    ga, gl, gi = place_global_data(engine, parsed)
+    qsh = NamedSharding(engine.mesh, P(QUERY_AXIS, None))
+    gq = build_global(qsh, (parsed["qpad"], parsed["na"]),
+                      parsed["q_local"].astype(
+                          engine.config.resolve_np_dtype(), copy=False),
+                      parsed["qlo"])
+    return ga, gl, gi, gq
+
+
+def place_global_data(engine, parsed: dict):
+    """Data-side placement only (attrs/labels/ids) — the heterogeneous-k
+    router shares this across query segments instead of paying an unused
+    full-query placement inside the timed region."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = engine.mesh
-    npad, qpad, na = parsed["npad"], parsed["qpad"], parsed["na"]
+    npad, na = parsed["npad"], parsed["na"]
     dsh2 = NamedSharding(mesh, P(DATA_AXIS, None))
     dsh1 = NamedSharding(mesh, P(DATA_AXIS))
-    qsh = NamedSharding(mesh, P(QUERY_AXIS, None))
     # Stage attrs in the engine's resolved dtype: each process converts
     # its own shard on host, so bf16 halves the per-host feed bytes (the
     # DCN-side analog of the single-chip staging win, BENCH_BF16_r04).
-    np_dtype = (ml_dtypes.bfloat16
-                if engine.config.resolve_dtype() == "bfloat16"
-                else np.float32)
+    np_dtype = engine.config.resolve_np_dtype()
     ga = build_global(dsh2, (npad, na),
                       parsed["p_attrs"].astype(np_dtype, copy=False),
                       parsed["dlo"])
     gl = build_global(dsh1, (npad,), parsed["p_labels"], parsed["dlo"])
     gi = build_global(dsh1, (npad,), parsed["p_ids"], parsed["dlo"])
-    gq = build_global(qsh, (qpad, na),
-                      parsed["q_local"].astype(np_dtype, copy=False),
-                      parsed["qlo"])
-    return ga, gl, gi, gq
+    return ga, gl, gi
 
 
 def stage_global_inputs(path: str, engine):
@@ -298,6 +305,35 @@ def stage_global_inputs(path: str, engine):
     parsed = read_local_inputs(path, engine)
     ga, gl, gi, gq = place_global_inputs(engine, parsed)
     return ga, gl, gi, gq, parsed["params"], parsed["ks"], parsed["local"]
+
+
+def place_query_subset(engine, q64: np.ndarray, idx: np.ndarray,
+                       qgran: int):
+    """Global query-axis placement of the query rows in ``idx``.
+
+    Queries are replicated on every process (read_row_range), so each
+    process can serve any slice of the padded subset directly — used by
+    the heterogeneous-k router to feed each segment its own query array
+    while the (large) data placement is shared. Returns (global_array,
+    qpad)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dmlp_tpu.engine.single import round_up
+
+    mesh = engine.mesh
+    c = mesh.devices.shape[1]
+    na = q64.shape[1]
+    nqs = len(idx)
+    qpad = c * round_up(max(-(-nqs // c), 1), qgran)
+    # Stage through f32 like every other site (f64 -> f32 -> bf16): a
+    # direct f64 -> bf16 round can differ in the last ulp near a bf16
+    # midpoint, and staged bytes stay bit-identical across paths.
+    qh = np.zeros((qpad, na), np.float32)
+    qh[:nqs] = q64[idx]
+    qh = qh.astype(engine.config.resolve_np_dtype(), copy=False)
+    qsh = NamedSharding(mesh, P(QUERY_AXIS, None))
+    return jax.make_array_from_callback(
+        (qpad, na), qsh, lambda ix: qh[ix]), qpad
 
 
 def sharded_solve_from_file(path: str, engine):
@@ -451,13 +487,17 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
     parsed = read_local_inputs(path, engine)
     params, ks, local = parsed["params"], parsed["ks"], parsed["local"]
 
-    def solve():
-        ga, gl, gi, gq = place_global_inputs(engine, parsed)
-        nq = params.num_queries
-        kmax = int(ks.max()) if nq else 1
+    def solve_segment(ga, gl, gi, gq, ks_seg, q64_seg, idx):
+        """Per-shard solve + distributed f64 rescore + host all-gather +
+        finalize for one query segment (the whole query set when idx is
+        None)."""
+        nqs = len(ks_seg)
+        kmax = int(ks_seg.max()) if nqs else 1
         top = engine.solve_local_shards(ga, gl, gi, gq, kmax)
+        local_s = dict(local, query_attrs=q64_seg)
         my_d, my_l, my_i = rescore_local_shards(
-            top, local, ks, nq, staging=engine.config.resolve_dtype())
+            top, local_s, ks_seg, nqs,
+            staging=engine.config.resolve_dtype())
 
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
@@ -471,10 +511,38 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
         # (R, Qpad, K) -> (Q, R*K): per query, all shards' candidates.
         r_axis, qpad, kcap = my_d.shape
         flat = lambda x: x.transpose(1, 0, 2).reshape(qpad, r_axis * kcap)  # noqa: E731
-        results = finalize_host(flat(my_d)[:nq], flat(my_l)[:nq],
-                                flat(my_i)[:nq], ks,
-                                local["query_attrs"], None, exact=False)
-        return results
+        return finalize_host(flat(my_d)[:nqs], flat(my_l)[:nqs],
+                             flat(my_i)[:nqs], ks_seg, q64_seg, None,
+                             exact=False, query_ids=idx)
+
+    def solve():
+        from dmlp_tpu.engine.single import hetk_split, round_up
+
+        nq = params.num_queries
+        n = params.num_data
+        r = engine.mesh.devices.shape[0]
+        split = hetk_split(engine.config, engine.config.resolve_dtype(),
+                           ks, n, round_up(max(-(-n // r), 1), 8))
+        if split is None:
+            ga, gl, gi, gq = place_global_inputs(engine, parsed)
+            return solve_segment(ga, gl, gi, gq, ks,
+                                 local["query_attrs"], None)
+
+        # Heterogeneous-k routing, multi-host form: the (large) data
+        # placement is shared; each segment gets its own query-axis feed
+        # (queries are replicated per process) — bulk on the per-shard
+        # extraction kernel, wide-k outliers on the streaming select.
+        from dmlp_tpu.ops.pallas_extract import QUERY_TILE
+        bulk_idx, out_idx = split
+        ga, gl, gi = place_global_data(engine, parsed)
+        merged = [None] * nq
+        q64 = local["query_attrs"]
+        for idx, qgran in ((bulk_idx, QUERY_TILE), (out_idx, 8)):
+            gq_s, _ = place_query_subset(engine, q64, idx, qgran)
+            for res in solve_segment(ga, gl, gi, gq_s, ks[idx],
+                                     q64[idx], idx):
+                merged[res.query_id] = res
+        return merged
 
     if warmup:
         solve()

@@ -198,3 +198,60 @@ def test_process_slice_rejects_non_contiguous_block():
 
     with pytest.raises(ValueError, match="not contiguous"):
         process_slice(GappySharding(), (32, 4))
+
+
+def test_contract_run_hetk_routing_matches_golden(tmp_path):
+    """Heterogeneous-k routing on the multi-host path: data placed once,
+    bulk queries on the per-shard extraction kernel, wide-k outliers on
+    the streaming select with their own query feed; proc-0 output must
+    still be byte-identical to golden."""
+    from dmlp_tpu.io.grammar import KNNInput, Params, format_input
+    from dmlp_tpu.parallel.distributed import distributed_contract_run
+
+    rng = np.random.default_rng(91)
+    n, nq, na = 700, 12, 4
+    data = rng.uniform(0, 40, (n, na))
+    queries = rng.uniform(0, 40, (nq, na))
+    labels = rng.integers(0, 5, n).astype(np.int32)
+    ks = rng.integers(1, 25, nq).astype(np.int32)
+    ks[3], ks[9] = 600, 700
+    inp = parse_input_text(format_input(
+        KNNInput(Params(n, nq, na), labels, data, ks, queries)))
+    path = tmp_path / "hetk.txt"
+    path.write_text(format_input(inp))
+    want = [r.checksum() for r in knn_golden(inp)]
+
+    engine = ShardedEngine(
+        EngineConfig(mode="sharded", select="extract", use_pallas=True),
+        mesh=make_mesh())
+    got = distributed_contract_run(str(path), engine,
+                                   out=open(os.devnull, "w"),
+                                   err=open(os.devnull, "w"))
+    assert [r.query_id for r in got] == list(range(nq))
+    assert [r.checksum() for r in got] == want
+
+
+def test_two_process_hetk_contract_run_matches_golden(tmp_path):
+    """The same routed solve across a real 2-process Gloo cluster."""
+    from dmlp_tpu.io.grammar import KNNInput, Params, format_input
+
+    rng = np.random.default_rng(92)
+    n, nq, na = 640, 8, 3
+    data = rng.uniform(0, 30, (n, na))
+    queries = rng.uniform(0, 30, (nq, na))
+    labels = rng.integers(0, 4, n).astype(np.int32)
+    ks = rng.integers(1, 20, nq).astype(np.int32)
+    ks[5] = 640
+    inp = parse_input_text(format_input(
+        KNNInput(Params(n, nq, na), labels, data, ks, queries)))
+    path = tmp_path / "hetk2.txt"
+    path.write_text(format_input(inp))
+    want = format_results(knn_golden(inp))
+
+    port = _free_port()
+    extra = ("--select", "extract", "--pallas")
+    procs = [_spawn(path, port, 2, pid, 4, extra) for pid in range(2)]
+    outs = [p.communicate(timeout=420) for p in procs]
+    for p, (o, e) in zip(procs, outs):
+        assert p.returncode == 0, e.decode()[-2000:]
+    assert outs[0][0].decode() == want
