@@ -31,8 +31,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dmlp_tpu.config import EngineConfig
 from dmlp_tpu.engine.finalize import (boundary_overflow, finalize_host,
                                       repair_boundary_overflow, staging_eps)
-from dmlp_tpu.engine.single import (fit_blocks, pad_dataset, resolve_kcap,
-                                    round_up)
+from dmlp_tpu.engine.single import (ChunkThrottle, fit_blocks, pad_dataset,
+                                    resolve_kcap, round_up)
 from dmlp_tpu.io.grammar import KNNInput
 from dmlp_tpu.io.report import QueryResult
 from dmlp_tpu.ops.topk import TopK, streaming_topk
@@ -425,6 +425,7 @@ class ShardedEngine:
             ostep = self._outlier_fold_fn(ko, select_out)
 
         src = np.ascontiguousarray(inp.data_attrs, np.float32)
+        throttle = ChunkThrottle()
         for t in range(nchunks):
             toff = t * chunk_rows
             # Staging buffer directly in the wire dtype: slice assignment
@@ -445,6 +446,7 @@ class ShardedEngine:
             cd, ci = step(cd, ci, a_dev, q_dev, sc)
             if ostep is not None:
                 od, ol, oi = ostep(od, ol, oi, a_dev, qo_dev, lab_dev, sc)
+            throttle.tick(od if ostep is not None else cd)
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         top_b = self._chunk_merge_fn(k)(cd, ci, lab_dev)

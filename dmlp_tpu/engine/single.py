@@ -40,6 +40,32 @@ from dmlp_tpu.ops.vote import majority_vote, report_order
 # this so HBM never holds a Q x N matrix.
 _TILE_BUDGET = 1 << 30
 
+# Max staged-but-unfolded chunks in flight. The enqueue loop runs far
+# ahead of device execution (staging, not the host, is the bottleneck),
+# and every jnp.asarray allocates its device buffer immediately — without
+# backpressure a dataset LARGER than HBM would stage itself to death
+# before the first folds free their chunks. Blocking on the fold output
+# W chunks back caps device residency at ~W chunks while still keeping
+# the transfer pipe full (W * 51200 * 64 * 4B ~= 105 MB at the default
+# chunk plan).
+_CHUNK_WINDOW = 8
+
+
+class ChunkThrottle:
+    """Sliding-window backpressure for chunked staging loops: feed each
+    chunk's fold output to tick(); it blocks on the output from
+    _CHUNK_WINDOW chunks ago, so at most that many staged chunks (plus
+    their folds) are ever in flight on device."""
+
+    def __init__(self, window: int = _CHUNK_WINDOW):
+        self._window = window
+        self._pending: list = []
+
+    def tick(self, fold_out) -> None:
+        self._pending.append(fold_out)
+        if len(self._pending) > self._window:
+            jax.block_until_ready(self._pending.pop(0))
+
 
 def round_up(x: int, m: int) -> int:
     return -(-x // m) * m
@@ -354,9 +380,11 @@ class SingleChipEngine:
         q_dev = [jnp.asarray(q_attrs[i * qsb:(i + 1) * qsb], self._dtype)
                  for i in range(nqb)]
 
-        # Stage chunks (async puts) and enqueue their folds immediately.
+        # Stage chunks (async puts) and enqueue their folds immediately,
+        # under the sliding-window backpressure (ChunkThrottle).
         carries = [init_topk(qsb, k) for _ in range(nqb)]
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
+        throttle = ChunkThrottle()
         for c in range(nchunks):
             lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
             a = np.zeros((chunk_rows, na), np.float32)
@@ -372,6 +400,7 @@ class SingleChipEngine:
                 carries[b] = _chunk_fold(carries[b], q_dev[b], da, dl, di,
                                          k=k, select=select,
                                          use_pallas=cfg.use_pallas)
+            throttle.tick(carries[-1].dists)
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         if nqb == 1:
@@ -423,6 +452,7 @@ class SingleChipEngine:
         q_dev = jnp.asarray(q_attrs, self._dtype)
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
         od = oi = None
+        throttle = ChunkThrottle()
         for c in range(nchunks):
             lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
             if lo >= n:
@@ -434,6 +464,7 @@ class SingleChipEngine:
             od, oi, _iters = extract_topk(
                 q_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=k,
                 interpret=interpret)
+            throttle.tick(od)
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         top = _extract_finalize(od, oi, jnp.asarray(inp.labels), k=k)
@@ -509,6 +540,7 @@ class SingleChipEngine:
         carry_o = init_topk(qo_pad, ko)
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
         od = oi = None
+        throttle = ChunkThrottle()
         for c in range(nchunks):
             lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
             if lo >= n:
@@ -524,6 +556,7 @@ class SingleChipEngine:
                 carry_o, qo_dev, da, labels_dev, jnp.int32(lo),
                 jnp.int32(n), chunk_rows=chunk_rows, k=ko,
                 select=select_out, use_pallas=cfg.use_pallas)
+            throttle.tick(carry_o.dists)
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         top_b = _extract_finalize(od, oi, jnp.asarray(inp.labels), k=kb)
@@ -595,8 +628,9 @@ class SingleChipEngine:
             t0 = _time.perf_counter()
             # NOTE: the "fetch" phase time includes the wait for all
             # enqueued device work (staging + solve), not just the readback
-            # bytes — the enqueue phase above is host dispatch only. Don't
-            # read this table as "readback costs X ms".
+            # bytes — and past _CHUNK_WINDOW chunks the enqueue phase
+            # absorbs throttled transfer wait too. Don't read this table
+            # as "readback costs X ms".
             fetch = ([] if self.config.exact else [top.dists]) + [top.ids] \
                 + ([cols_dev] if cols_dev is not None else [])
             fetched = list(jax.device_get(fetch))

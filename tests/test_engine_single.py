@@ -198,3 +198,29 @@ def test_no_auto_coarsen_guard():
     eng2 = SingleChipEngine(EngineConfig(dtype="bfloat16"))
     with no_auto_coarsen(eng2):
         assert eng2._staging == "bfloat16"
+
+
+def test_chunk_throttle_window():
+    """The staging backpressure keeps at most W fold outputs pending and
+    blocks oldest-first (beyond-HBM streaming: without this, the enqueue
+    loop would allocate every chunk's device buffer ahead of execution)."""
+    from dmlp_tpu.engine.single import ChunkThrottle
+
+    waited = []
+
+    class _Fake:
+        def __init__(self, i):
+            self.i = i
+
+    import jax
+
+    orig = jax.block_until_ready
+    t = ChunkThrottle(window=3)
+    try:
+        jax.block_until_ready = lambda x: waited.append(x.i)
+        for i in range(10):
+            t.tick(_Fake(i))
+            assert len(t._pending) <= 3
+    finally:
+        jax.block_until_ready = orig
+    assert waited == [0, 1, 2, 3, 4, 5, 6]  # oldest-first, window kept full

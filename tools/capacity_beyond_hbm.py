@@ -1,0 +1,129 @@
+"""Beyond-HBM capacity proof: solve a dataset LARGER than device memory.
+
+SCALE_r04's 4M rung proved the dense distance tile never materializes
+(O(N*A + Q*K) working set). This tool proves the stronger streaming
+claim — the long-context analog (survey §5.7) — by running the chunked
+extract driver on a dataset whose f32 form EXCEEDS the chip's HBM: only
+the in-flight chunks (bounded by engine.single.ChunkThrottle), the
+queries, and the running (Q, K) lists are ever device-resident, so the
+solve completes where any monolithic staging would OOM by construction.
+
+Shape (default): 72M x 64 f32 = 18.4 GB, ~1.09x HBM. Queries kept small
+(2048) so the run is staging-bound, like a real larger-than-memory scan.
+Data is generated directly as arrays (the text grammar at 64M rows is a
+multi-GB file serving no purpose here); distribution matches the seeded
+generator (uniform [0, 100], labels uniform 0..9).
+
+Correctness: exact mode (f64 rescore + eps-hazard repair) end-to-end;
+additionally VALIDATE_QUERIES queries are solved by the vectorized f64
+oracle over the full 64M rows and diffed checksum-for-checksum.
+
+Writes CAPACITY_BEYOND_HBM_r04.json. Env: CAP_NUM_DATA, CAP_NUM_QUERIES,
+CAP_VALIDATE (default 8), BENCH_OUT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import numpy as np
+
+    import jax
+
+    from dmlp_tpu.config import EngineConfig
+    from dmlp_tpu.engine.single import SingleChipEngine
+    from dmlp_tpu.golden.fast import knn_golden_fast
+    from dmlp_tpu.io.grammar import KNNInput, Params, subset_queries
+    from dmlp_tpu.ops.pallas_distance import native_pallas_backend
+
+    if not native_pallas_backend():
+        print("needs the native TPU backend", file=sys.stderr)
+        return 1
+
+    n = int(os.environ.get("CAP_NUM_DATA", 72_000_000))
+    nq = int(os.environ.get("CAP_NUM_QUERIES", 2048))
+    nv = int(os.environ.get("CAP_VALIDATE", 8))
+    na, k = 64, 32
+    out_path = os.environ.get("BENCH_OUT", "CAPACITY_BEYOND_HBM_r04.json")
+
+    dev = jax.devices()[0]
+    hbm_bytes = 0
+    try:
+        stats = dev.memory_stats() or {}
+        hbm_bytes = int(stats.get("bytes_limit", 0))
+    except Exception:
+        pass
+    if not hbm_bytes:
+        hbm_bytes = int(15.75 * 2**30)  # v5e, memory_stats absent via tunnel
+
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(42)
+    # f32 directly (rng.random supports dtype; rng.uniform does not and
+    # would materialize a 2x-size f64 intermediate): this IS the staged
+    # form; f64 originals at this scale would double host memory for no
+    # benefit (the rescore casts gathered candidate rows only).
+    data = rng.random((n, na), dtype=np.float32) * np.float32(100)
+    labels = rng.integers(0, 10, n).astype(np.int32)
+    queries = rng.uniform(0, 100, (nq, na)).astype(np.float32)
+    ks = rng.integers(1, k + 1, nq).astype(np.int32)
+    gen_s = time.perf_counter() - t0
+    inp = KNNInput(Params(n, nq, na), labels, data, ks, queries)
+
+    # margin 64 (kcap 96): at 72M-row density the rank-32 distance gaps
+    # approach the f32 quantum, and a deeper window keeps the (exact)
+    # eps-hazard test clear of mass repairs.
+    eng = SingleChipEngine(EngineConfig(dtype="float32", use_pallas=True,
+                                        margin=64))
+    t0 = time.perf_counter()
+    results = eng.run(inp)
+    solve_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    vidx = np.linspace(0, nq - 1, nv).astype(np.int64)
+    golden = knn_golden_fast(subset_queries(inp, vidx))
+    mismatches = sum(
+        results[int(q)].checksum() != g.checksum()
+        for q, g in zip(vidx, golden))
+    validate_s = time.perf_counter() - t0
+
+    dataset_bytes = n * na * 4
+    doc = {
+        "note": "Chunked extract solve of a dataset LARGER than HBM: only "
+                "in-flight chunks (window-throttled), queries, and the "
+                "running lists are device-resident. Exact mode end-to-end; "
+                f"{nv} queries validated checksum-for-checksum against the "
+                "vectorized f64 oracle over the full dataset. wall_s is "
+                "staging-bound on the tunneled link (the dataset crosses "
+                "the host link once, in ~51k-row chunks overlapped with "
+                "the folds).",
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "num_data": n, "num_queries": nq, "num_attrs": na, "kmax": k,
+        "dataset_bytes_f32": dataset_bytes,
+        "hbm_bytes": hbm_bytes,
+        "dataset_vs_hbm": round(dataset_bytes / hbm_bytes, 3),
+        "select": eng._last_select,
+        "repairs": eng.last_repairs,
+        "gen_s": round(gen_s, 1),
+        "solve_wall_s": round(solve_s, 1),
+        "qd_pairs_per_sec_wall": int(n * nq / solve_s),
+        "phases_ms": {m: round(v, 1)
+                      for m, v in eng.last_phase_ms.items()},
+        "validated_queries": nv,
+        "validate_mismatches": int(mismatches),
+        "validate_s": round(validate_s, 1),
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc))
+    return 0 if mismatches == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
