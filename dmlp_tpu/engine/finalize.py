@@ -69,27 +69,41 @@ def rescore_f64(cand_ids: np.ndarray, query_attrs: np.ndarray,
 
 
 def staging_eps(last: np.ndarray, qn: np.ndarray, dn_max: float,
-                staging: str) -> np.ndarray:
-    """Per-query bound on the distance perturbation the staging dtype can
-    introduce, for the truncation-hazard test.
+                staging: str, na: int) -> np.ndarray:
+    """Per-query bound on the distance perturbation the device pipeline
+    can introduce, for the truncation-hazard test. Two terms:
 
-    Rounding attrs to the staging dtype perturbs each computed distance by
-    at most (first order, Cauchy-Schwarz over the per-attr terms)
+    1. ATTR ROUNDING — casting attrs to the staging dtype perturbs each
+       computed distance by at most (first order, Cauchy-Schwarz over the
+       per-attr terms)
 
-        |d~ - d| <= 2 * u * sqrt(d) * sqrt(2 * (|q|^2 + |x|^2))
+           |d~ - d| <= 2 * u * sqrt(d) * sqrt(2 * (|q|^2 + |x|^2))
 
-    where u is the half-ulp relative rounding (2^-9 for bfloat16, 2^-24
-    for float32) — NOTE this error is NOT monotone across points, so two
-    points' device distances can swap even without an exact device tie;
-    an exact-equality hazard test is sound only for exact device
-    arithmetic. Comparing the k-th candidate against a potentially missed
-    point doubles the bound; the constants below fold the 2 * sqrt(2) * 2
-    together with >= 1.4x slack for the second-order term and the f32
-    accumulation rounding. ``dn_max`` (max squared data-row norm, f64)
-    bounds |x|^2 over every point, known or missed.
+       with u the half-ulp relative rounding (2^-9 for bfloat16, 2^-24
+       for float32).
+    2. COMPUTATION — the norm-expansion form qn + dn - 2 q.x evaluates
+       three terms of magnitude ~(qn + dn) in f32 and CANCELS them, so
+       its rounding error scales with the MAGNITUDES, not the result:
+       ~(na + 2) * u32 * (qn + dn). When true distances are tiny against
+       the coordinate scale (clustered data), this term dwarfs term 1 —
+       the fuzz case the original attr-only bound missed: near-duplicate
+       points at coordinate scale ~5 have gaps ~1e-6 but f32 cancellation
+       error ~1e-5, silently reordering candidates past the margin.
+
+    Neither error is monotone across points, so two points' device
+    distances can swap even without an exact device tie — an
+    exact-equality hazard test is sound only for exact device arithmetic.
+    Comparing the k-th candidate against a potentially missed point
+    doubles both bounds; the constants fold the doubling, sqrt(2), a
+    >= 1.4x second-order slack, and (term 2) u32 = 2^-22 covering the
+    MXU's HIGHEST-precision 3-pass product error on top of f32
+    accumulation. ``dn_max`` (max squared data-row norm, f64) bounds
+    |x|^2 over every point, known or missed.
     """
     rel = 2.0 ** -6 if staging == "bfloat16" else 2.0 ** -21
-    return rel * np.sqrt(np.maximum(last, 0.0) * (qn + dn_max))
+    scale = qn + dn_max
+    return (rel * np.sqrt(np.maximum(last, 0.0) * scale)
+            + 3.0 * (na + 2) * 2.0 ** -22 * scale)
 
 
 def boundary_hazard(kth: np.ndarray, last: np.ndarray,

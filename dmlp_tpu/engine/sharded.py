@@ -605,7 +605,8 @@ class ShardedEngine:
             results = finalize_host(dists, labels, ids, sub.ks,
                                     sub.query_attrs, sub.data_attrs,
                                     exact=self.config.exact, query_ids=idx)
-            if select in ("topk", "seg", "extract") and dists.shape[1] < n:
+            if select in ("sort", "topk", "seg", "extract") \
+                    and dists.shape[1] < n:
                 # Per-shard truncation surfaces on the merged lists: a
                 # point dropped by shard s has device dist > that shard's
                 # horizon, and the merged kcap-th <= any shard's kcap-th,
@@ -619,7 +620,8 @@ class ShardedEngine:
                                              inp.data_attrs).max())
                 qn = np.einsum("qa,qa->q", sub.query_attrs, sub.query_attrs)
                 eps = staging_eps(np.asarray(dists[:, -1], np.float64), qn,
-                                  dn_max, self._staging)
+                                  dn_max, self._staging,
+                                  inp.params.num_attrs)
                 suspects = np.nonzero(
                     boundary_overflow(dists, sub.ks, eps))[0]
                 if suspects.size:

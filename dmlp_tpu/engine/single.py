@@ -119,7 +119,7 @@ def resolve_kcap(cfg: EngineConfig, kmax: int, select: str, cap: int,
     (vectorized-oracle) repair stays as the sound backstop for inputs
     whose distance density outruns it."""
     extra = cfg.margin if cfg.exact else 0
-    if select in ("topk", "seg", "extract"):
+    if select in ("sort", "topk", "seg", "extract"):
         extra = max(extra, 8)
     if staging == "bfloat16" and cfg.exact:
         extra = max(extra, 96 + kmax // 2)
@@ -609,8 +609,7 @@ class SingleChipEngine:
         merged: List[QueryResult] = [None] * inp.params.num_queries
         # Max squared data-row norm (f64): scales the staging-dtype
         # perturbation bound of the hazard test — computed on first need
-        # only (an O(N*A) host pass the "sort" / kcap >= n paths never
-        # use).
+        # only (an O(N*A) host pass the kcap >= n case never uses).
         dn_max = None
 
         fetch_ms = final_ms = 0.0
@@ -620,7 +619,7 @@ class SingleChipEngine:
             kcap = top.dists.shape[1]
 
             cols_dev = None
-            if select in ("topk", "seg", "extract") and kcap < n:
+            if select in ("sort", "topk", "seg", "extract") and kcap < n:
                 ks_pad = np.ones(qpad, np.int32)
                 ks_pad[:nq] = sub.ks
                 cols_dev = _boundary_cols(top.dists, jnp.asarray(ks_pad))
@@ -645,7 +644,8 @@ class SingleChipEngine:
                         "na,na->n", inp.data_attrs, inp.data_attrs).max()) \
                         if n else 0.0
                 qn = np.einsum("qa,qa->q", sub.query_attrs, sub.query_attrs)
-                eps = staging_eps(last, qn, dn_max, self._staging)
+                eps = staging_eps(last, qn, dn_max, self._staging,
+                                  inp.params.num_attrs)
                 flags = boundary_hazard(kth, last, eps)
             labels = np.where(ids >= 0,
                               inp.labels[np.clip(ids, 0, max(n - 1, 0))], -1) \

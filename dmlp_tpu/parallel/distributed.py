@@ -443,7 +443,8 @@ def rescore_local_shards(top, local, ks: np.ndarray, nq: int,
                                      attrs64[sh_lo:sh_hi]).max())
                      if sh_hi > sh_lo else 0.0)
         last_blk = np.asarray(f32_blk[:, -1], np.float64)
-        eps = staging_eps(last_blk, qn_blk, dn_max_sh, staging)
+        eps = staging_eps(last_blk, qn_blk, dn_max_sh, staging,
+                          attrs64.shape[1])
         hazard = boundary_hazard(kth, last_blk, eps) \
             & (qrows < nq) & (kcap < sh_hi - sh_lo)
         if hazard.any():
