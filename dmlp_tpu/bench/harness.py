@@ -273,8 +273,18 @@ def run_config(config_id: int, base_dir: str = ".",
                mode: Optional[str] = None, fast: bool = False,
                force_oracle: bool = False, out: Optional[TextIO] = None,
                timeout_s: float = 300.0, env: Optional[dict] = None,
-               ) -> dict:
-    """Full benchmark flow for one config; returns a result summary dict."""
+               reps: int = 1) -> dict:
+    """Full benchmark flow for one config; returns a result summary dict.
+
+    ``reps`` > 1 runs the engine subprocess that many times and reports
+    the MEDIAN engine time (all runs' times recorded as engine_ms_reps;
+    checksums verified on every run). The reference protocol is
+    single-shot (one mpirun each, run_bench.sh:82-84) over a quiet SLURM
+    interconnect; this chip sits behind a tunneled link whose throughput
+    swings up to 30x within minutes (BENCH_MODES_r04.json), so a
+    single-shot engine time measures weather, not the engine. Deviation
+    documented here and visible in the artifact.
+    """
     import sys
 
     out = out or sys.stdout
@@ -285,18 +295,36 @@ def run_config(config_id: int, base_dir: str = ".",
     input_path = ensure_input(cfg, inputs_dir)
     oracle_out, oracle_err = ensure_oracle(cfg, input_path, outputs_dir, out,
                                            force=force_oracle)
+    with open(oracle_out) as f:
+        want = f.read()
+    if cfg.procs > 1 and (mode or fast):
+        out.write(f"Config {config_id}: note — --mode/--fast do not apply "
+                  "to multi-process configs (the cluster runs the full "
+                  "exact contract pipeline)\n")
+    n_reps = max(reps, 1)
     try:
-        if cfg.procs > 1:
-            if mode or fast:
-                out.write(f"Config {config_id}: note — --mode/--fast do "
-                          "not apply to multi-process configs (the cluster "
-                          "runs the full exact contract pipeline)\n")
-            engine_out, engine_err = run_engine_multiproc(
-                cfg, input_path, outputs_dir, timeout_s=timeout_s, env=env)
-        else:
-            engine_out, engine_err = run_engine(cfg, input_path, outputs_dir,
-                                                mode=mode, fast=fast,
-                                                timeout_s=timeout_s, env=env)
+        rep_ms = []
+        for _rep in range(n_reps):
+            if cfg.procs > 1:
+                engine_out, engine_err = run_engine_multiproc(
+                    cfg, input_path, outputs_dir, timeout_s=timeout_s,
+                    env=env)
+            else:
+                engine_out, engine_err = run_engine(
+                    cfg, input_path, outputs_dir, mode=mode, fast=fast,
+                    timeout_s=timeout_s, env=env)
+            if _rep < n_reps - 1:
+                # Early-out on a broken engine — but only in exact mode:
+                # --fast documents checksum diffs vs the f64 oracle as
+                # expected, so a mismatch there must not eat the reps.
+                if not fast:
+                    with open(engine_out) as f:
+                        if f.read() != want:
+                            break  # mismatch: stop repping, report below
+                with open(engine_err) as f:
+                    ms = _extract_ms(f.read())
+                if ms is not None:
+                    rep_ms.append(ms)
     except EngineTimeout as e:
         out.write(f"Config {config_id}: TIMEOUT ({e})\n")
         return {"config": config_id, "checksums_match": False,
@@ -310,8 +338,6 @@ def run_config(config_id: int, base_dir: str = ".",
                 "error": str(e), "oracle_ms": None, "engine_ms": None,
                 "percent_vs_oracle": None}
 
-    with open(oracle_out) as f:
-        want = f.read()
     with open(engine_out) as f:
         got = f.read()
     checksums_match = want == got
@@ -323,10 +349,22 @@ def run_config(config_id: int, base_dir: str = ".",
         oe = f.read()
     with open(engine_err) as f:
         ee = f.read()
-    percent = compare_times(oe, ee, out)
-    return {"config": config_id, "checksums_match": checksums_match,
-            "oracle_ms": _extract_ms(oe), "engine_ms": _extract_ms(ee),
-            "percent_vs_oracle": percent}
+    percent = compare_times(oe, ee, out)  # human report: last run
+    rep_ms.append(_extract_ms(ee))
+    rep_ms = [m for m in rep_ms if m is not None]
+    engine_ms = _extract_ms(ee)
+    oracle_ms = _extract_ms(oe)
+    res = {"config": config_id, "checksums_match": checksums_match,
+           "oracle_ms": oracle_ms, "engine_ms": engine_ms,
+           "percent_vs_oracle": percent}
+    if len(rep_ms) > 1:
+        import statistics
+        res["engine_ms"] = int(statistics.median(rep_ms))
+        res["engine_ms_reps"] = rep_ms
+        if oracle_ms:
+            res["percent_vs_oracle"] = (
+                (res["engine_ms"] - oracle_ms) / oracle_ms * 100.0)
+    return res
 
 
 def main(argv=None) -> int:
