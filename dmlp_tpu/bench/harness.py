@@ -302,9 +302,13 @@ def run_config(config_id: int, base_dir: str = ".",
                   "to multi-process configs (the cluster runs the full "
                   "exact contract pipeline)\n")
     n_reps = max(reps, 1)
-    try:
-        rep_ms = []
-        for _rep in range(n_reps):
+    # Checksums are verified on every rep except in single-process --fast
+    # mode, where f64-oracle diffs are documented as expected output.
+    check_reps = not (fast and cfg.procs == 1)
+    rep_ms: list = []
+    got = ee = None
+    for _rep in range(n_reps):
+        try:
             if cfg.procs > 1:
                 engine_out, engine_err = run_engine_multiproc(
                     cfg, input_path, outputs_dir, timeout_s=timeout_s,
@@ -313,33 +317,39 @@ def run_config(config_id: int, base_dir: str = ".",
                 engine_out, engine_err = run_engine(
                     cfg, input_path, outputs_dir, mode=mode, fast=fast,
                     timeout_s=timeout_s, env=env)
-            if _rep < n_reps - 1:
-                # Early-out on a broken engine — but only in exact mode:
-                # --fast documents checksum diffs vs the f64 oracle as
-                # expected, so a mismatch there must not eat the reps.
-                if not fast:
-                    with open(engine_out) as f:
-                        if f.read() != want:
-                            break  # mismatch: stop repping, report below
-                with open(engine_err) as f:
-                    ms = _extract_ms(f.read())
-                if ms is not None:
-                    rep_ms.append(ms)
-    except EngineTimeout as e:
-        out.write(f"Config {config_id}: TIMEOUT ({e})\n")
-        return {"config": config_id, "checksums_match": False,
-                "timeout": True, "oracle_ms": None, "engine_ms": None,
-                "percent_vs_oracle": None}
-    except RuntimeError as e:
-        # A crashing engine fails its config, not the whole suite — the
-        # same isolation the timeout gives a hung one.
-        out.write(f"Config {config_id}: ERROR ({e})\n")
-        return {"config": config_id, "checksums_match": False,
-                "error": str(e), "oracle_ms": None, "engine_ms": None,
-                "percent_vs_oracle": None}
+        except (EngineTimeout, RuntimeError) as e:
+            if got is not None:
+                # Later-rep flake on the swinging link: keep the earlier
+                # good reps instead of failing a config that already
+                # produced a verified result (the reason reps exist).
+                out.write(f"Config {config_id}: rep {_rep + 1}/{n_reps} "
+                          f"failed ({e}); keeping {len(rep_ms)} good "
+                          "rep(s)\n")
+                break
+            kind = "TIMEOUT" if isinstance(e, EngineTimeout) else "ERROR"
+            out.write(f"Config {config_id}: {kind} ({e})\n")
+            res = {"config": config_id, "checksums_match": False,
+                   "oracle_ms": None, "engine_ms": None,
+                   "percent_vs_oracle": None}
+            res["timeout" if kind == "TIMEOUT" else "error"] = \
+                True if kind == "TIMEOUT" else str(e)
+            return res
+        with open(engine_out) as f:
+            got_r = f.read()
+        with open(engine_err) as f:
+            ee_r = f.read()
+        if check_reps and got_r != want:
+            # A mismatching run's timing must not enter the median — the
+            # artifact would otherwise carry a number derived from wrong
+            # output with only checksums_match hinting at it.
+            got, ee = got_r, ee_r
+            rep_ms = []
+            break
+        got, ee = got_r, ee_r
+        ms = _extract_ms(ee_r)
+        if ms is not None:
+            rep_ms.append(ms)
 
-    with open(engine_out) as f:
-        got = f.read()
     checksums_match = want == got
     status = "PASS" if checksums_match else "FAIL"
     out.write(f"Config {config_id}: checksums {status} "
@@ -347,19 +357,14 @@ def run_config(config_id: int, base_dir: str = ".",
 
     with open(oracle_err) as f:
         oe = f.read()
-    with open(engine_err) as f:
-        ee = f.read()
     percent = compare_times(oe, ee, out)  # human report: last run
-    rep_ms.append(_extract_ms(ee))
-    rep_ms = [m for m in rep_ms if m is not None]
-    engine_ms = _extract_ms(ee)
     oracle_ms = _extract_ms(oe)
     res = {"config": config_id, "checksums_match": checksums_match,
-           "oracle_ms": oracle_ms, "engine_ms": engine_ms,
+           "oracle_ms": oracle_ms, "engine_ms": _extract_ms(ee),
            "percent_vs_oracle": percent}
     if len(rep_ms) > 1:
         import statistics
-        res["engine_ms"] = int(statistics.median(rep_ms))
+        res["engine_ms"] = round(statistics.median(rep_ms))
         res["engine_ms_reps"] = rep_ms
         if oracle_ms:
             res["percent_vs_oracle"] = (
@@ -383,6 +388,10 @@ def main(argv=None) -> int:
     p.add_argument("--timeout", type=float, default=300.0,
                    help="per-config engine kill timeout in seconds "
                         "(mpirun --timeout 300 analog)")
+    p.add_argument("--reps", type=int, default=1,
+                   help="engine runs per config; >1 reports the median "
+                        "(de-weathers the tunneled link; the reference "
+                        "protocol is single-shot)")
     args = p.parse_args(argv)
 
     ids = list(BENCH_CONFIGS) if args.config == "all" else [int(args.config)]
@@ -390,7 +399,7 @@ def main(argv=None) -> int:
     for cid in ids:
         res = run_config(cid, base_dir=args.base_dir, mode=args.mode,
                          fast=args.fast, force_oracle=args.force_oracle,
-                         timeout_s=args.timeout)
+                         timeout_s=args.timeout, reps=args.reps)
         ok = ok and res["checksums_match"]
     return 0 if ok else 1
 

@@ -1,0 +1,226 @@
+"""Pipeline-parallel training (GPipe-style microbatching) over a
+("dp", "pp") mesh — the pp rung of the mesh-parallelism ladder next to
+the dp x tp step (train.step / train.sharding).
+
+The reference has no training at all (survey §2: TP/PP absent); this is
+part of the north-star extension, built the TPU way rather than as a
+port of MPMD pipeline frameworks: ONE jitted SPMD program in which
+
+- the layer stack is split into S contiguous stages, stacked into
+  uniform (S, P, H, H) arrays and sharded over the mesh's "pp" axis
+  (each pp cell holds only its stage's weights);
+- a ``lax.scan`` over M + S - 1 ticks runs the pipeline schedule: at
+  tick t, stage s computes microbatch m = t - s and hands its
+  activation to stage s+1 via ``lax.ppermute`` over ICI — the bubble
+  (ticks where m is out of range) is masked, not branched, because XLA
+  wants static control flow;
+- the last stage's outputs are psum-broadcast and the loss is a
+  ``pmean`` over "dp" — plain ``jax.grad`` differentiates through the
+  scan + ppermute (XLA emits the reverse-schedule permutes), so there
+  is no hand-written backward pass.
+
+Input projection and readout are computed replicated on every pp cell
+(they are O(H) of the O(P * H^2) stage work); batches shard over "dp"
+with per-cell loss pmean'd, so data parallelism composes with the
+pipeline in the same program.
+
+Microbatch semantics: the loss is the mean over the full (per-dp-cell)
+batch, so gradients equal the unpipelined model's — proven by the
+equivalence test against a flat single-device stack
+(tests/test_train_pipeline.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+PP_AXIS = "pp"
+
+PipeParams = Dict[str, jax.Array]
+
+
+def make_pp_mesh(dp: int, pp: int, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if dp * pp > len(devices):
+        raise ValueError(f"need {dp * pp} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:dp * pp]).reshape(dp, pp),
+                (DP_AXIS, PP_AXIS))
+
+
+def init_pipeline(key, d_in: int, hidden: int, n_classes: int,
+                  stages: int, layers_per_stage: int,
+                  dtype=jnp.float32) -> PipeParams:
+    """Uniform pipeline body: stages x layers_per_stage (H, H) layers,
+    plus replicated input projection and readout. Stacked so the stage
+    axis shards with P("pp", ...)."""
+    ks = jax.random.split(key, 4)
+    s, p, h = stages, layers_per_stage, hidden
+    scale = jnp.sqrt(2.0 / h).astype(dtype)
+    return {
+        "in_w": jax.random.normal(ks[0], (d_in, h), dtype)
+        * jnp.sqrt(2.0 / d_in).astype(dtype),
+        "in_b": jnp.zeros((h,), dtype),
+        "pp_w": jax.random.normal(ks[1], (s, p, h, h), dtype) * scale,
+        "pp_b": jnp.zeros((s, p, h), dtype),
+        "out_w": jax.random.normal(ks[2], (h, n_classes), dtype)
+        * jnp.sqrt(2.0 / h).astype(dtype),
+        "out_b": jnp.zeros((n_classes,), dtype),
+    }
+
+
+def pipeline_param_shardings(mesh: Mesh):
+    def sh(spec):
+        return NamedSharding(mesh, spec)
+    return {
+        "in_w": sh(P(None, None)), "in_b": sh(P(None)),
+        "pp_w": sh(P(PP_AXIS, None, None, None)),
+        "pp_b": sh(P(PP_AXIS, None, None)),
+        "out_w": sh(P(None, None)), "out_b": sh(P(None)),
+    }
+
+
+def _stage_block(w, b, h):
+    """One stage's layers_per_stage dense+relu layers. w: (P, H, H)."""
+    def layer(h, wb):
+        wi, bi = wb
+        return jax.nn.relu(
+            jax.lax.dot_general(h, wi, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            + bi).astype(h.dtype), None
+    h, _ = jax.lax.scan(layer, h, (w, b))
+    return h
+
+
+def _pp_body(params, x, y, *, n_stages: int, n_micro: int, n_classes: int):
+    """Per-(dp, pp)-cell pipelined loss (runs inside shard_map).
+
+    ``params["pp_w"]`` arrives as this cell's (1, P, H, H) stage slice;
+    x/y are this dp cell's local batch, replicated over pp.
+    """
+    s_idx = jax.lax.axis_index(PP_AXIS)
+    w_s = params["pp_w"][0]
+    b_s = params["pp_b"][0]
+
+    h0 = x.astype(jnp.float32) @ params["in_w"] + params["in_b"]
+    mb = h0.shape[0] // n_micro
+    h_mb = h0.reshape(n_micro, mb, -1)
+
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        act, ys = carry
+        m = t - s_idx  # this stage's microbatch index at this tick
+        # Stage 0 pulls fresh microbatches; later stages consume the
+        # activation handed over at the previous tick. Bubbles (m out of
+        # range) compute on zeros and are masked at collection.
+        fresh = h_mb[jnp.clip(t, 0, n_micro - 1)]
+        inp = jnp.where(s_idx == 0, fresh, act)
+        out = _stage_block(w_s, b_s, inp)
+        # Last stage collects its finished microbatch.
+        take = (s_idx == n_stages - 1) & (m >= 0) & (m < n_micro)
+        ys = jnp.where(
+            take,
+            jax.lax.dynamic_update_index_in_dim(
+                ys, out, jnp.clip(m, 0, n_micro - 1), 0),
+            ys)
+        # Hand the activation to the next stage (stage 0 receives zeros;
+        # the last stage's output is not forwarded).
+        act = jax.lax.ppermute(out, PP_AXIS, perm) if n_stages > 1 else out
+        return (act, ys), None
+
+    ys0 = jnp.zeros_like(h_mb)
+    act0 = jnp.zeros_like(h_mb[0])
+    (_, ys), _ = jax.lax.scan(tick, (act0, ys0),
+                              jnp.arange(n_micro + n_stages - 1))
+
+    # Loss as a PER-CELL PARTIAL (nonzero only on the last stage), summed
+    # OUTSIDE the shard_map: no collective touches the loss path, so the
+    # grad transpose is exact by construction — replicated-output specs
+    # under check_vma=False are a known axis-size-overcount sharp edge,
+    # and in-body psums on the loss would reintroduce it.
+    h_out = ys.reshape(h0.shape)
+    logits = h_out @ params["out_w"] + params["out_b"]
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+    last = (s_idx == n_stages - 1).astype(loss.dtype)
+    return (loss * last)[None], (acc * last)[None]
+
+
+def make_pp_train_step(mesh: Mesh, optimizer: optax.GradientTransformation,
+                       *, n_micro: int, n_classes: int):
+    """Jitted (state, x, y) -> (state', {loss, accuracy}) over the
+    ("dp", "pp") mesh. ``state`` = {"params", "opt", "step"} with params
+    placed by pipeline_param_shardings."""
+    n_stages = mesh.devices.shape[1]
+
+    pspecs = {
+        "in_w": P(None, None), "in_b": P(None),
+        "pp_w": P(PP_AXIS, None, None, None),
+        "pp_b": P(PP_AXIS, None, None),
+        "out_w": P(None, None), "out_b": P(None),
+    }
+
+    n_dp = mesh.devices.shape[0]
+    body = functools.partial(_pp_body, n_stages=n_stages, n_micro=n_micro,
+                             n_classes=n_classes)
+    sharded_loss = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspecs, P(DP_AXIS, None), P(DP_AXIS)),
+        out_specs=(P((DP_AXIS, PP_AXIS)), P((DP_AXIS, PP_AXIS))),
+        check_vma=False)
+
+    def loss_fn(params, x, y):
+        # (dp * pp,) partials, one nonzero per dp row (its last stage);
+        # mean over dp rows happens here in plain math.
+        loss_p, acc_p = sharded_loss(params, x, y)
+        return loss_p.sum() / n_dp, acc_p.sum() / n_dp
+
+    def step(state, x, y):
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], x, y)
+        updates, opt = optimizer.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt": opt, "step": state["step"] + 1},
+                {"loss": loss, "accuracy": acc})
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def build_pp_state(mesh: Mesh, optimizer, d_in: int, hidden: int,
+                   n_classes: int, layers_per_stage: int, seed: int = 0):
+    """Init + place pipeline params; optimizer moments inherit placement."""
+    stages = mesh.devices.shape[1]
+    params = init_pipeline(jax.random.PRNGKey(seed), d_in, hidden,
+                           n_classes, stages, layers_per_stage)
+    sh = pipeline_param_shardings(mesh)
+    placed = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+    return {"params": placed, "opt": optimizer.init(placed),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def flatten_pipeline(params: PipeParams) -> Tuple:
+    """The mathematically equivalent single-device stack:
+    in -> S*P dense+relu (H, H) layers -> readout. For the equivalence
+    test and for flat-reference inference."""
+    s, p, h, _ = params["pp_w"].shape
+    ws = np.asarray(params["pp_w"]).reshape(s * p, h, h)
+    bs = np.asarray(params["pp_b"]).reshape(s * p, h)
+    return (np.asarray(params["in_w"]), np.asarray(params["in_b"]),
+            ws, bs, np.asarray(params["out_w"]), np.asarray(params["out_b"]))
+
+
+def flat_forward(flat, x):
+    """NumPy/JAX reference forward for flatten_pipeline output."""
+    in_w, in_b, ws, bs, out_w, out_b = flat
+    h = x.astype(jnp.float32) @ in_w + in_b
+    for wi, bi in zip(ws, bs):
+        h = jax.nn.relu(h @ wi + bi)
+    return h @ out_w + out_b
