@@ -69,3 +69,20 @@ def dryrun_train(devices: Sequence[jax.Device]) -> None:
         want = float(optax.softmax_cross_entropy_with_integer_labels(
             flat_forward(flat, jnp.asarray(xb)), jnp.asarray(yb)).mean())
         np.testing.assert_allclose(float(pm["loss"]), want, rtol=2e-5)
+
+        # Expert parallelism: one (dp, ep) MoE step, checked against the
+        # unsharded reference forward.
+        from dmlp_tpu.train.experts import (build_moe_state, make_ep_mesh,
+                                            make_moe_train_step,
+                                            moe_reference_forward)
+        emesh = make_ep_mesh(dp_pp, 4, devices=devices)
+        estate = build_moe_state(emesh, optimizer, 6, 16, 24, 4, 8, seed=9)
+        ref = {k: jnp.asarray(np.asarray(v))
+               for k, v in estate["params"].items()}
+        estep = make_moe_train_step(emesh, optimizer, n_experts=8,
+                                    n_classes=4)
+        estate, em = estep(estate, jnp.asarray(xb), jnp.asarray(yb))
+        ew = float(optax.softmax_cross_entropy_with_integer_labels(
+            moe_reference_forward(ref, jnp.asarray(xb)),
+            jnp.asarray(yb)).mean())
+        np.testing.assert_allclose(float(em["loss"]), ew, rtol=2e-5)

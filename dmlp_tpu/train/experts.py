@@ -1,0 +1,172 @@
+"""Expert parallelism: a top-1-routed MoE FFN with experts sharded over
+an ("dp", "ep") mesh — the ep rung of the mesh-parallelism ladder next
+to dp x tp (train.step/sharding) and dp x pp (train.pipeline).
+
+The reference has no MoE (survey §2: EP n/a); this is north-star
+extension surface, built SPMD: expert weights are stacked (E, H, F) /
+(E, F, H) and sharded over "ep" so each cell holds E/ep experts; inside
+``shard_map`` every cell computes its LOCAL experts over all (per-dp)
+tokens under the routing mask and the contributions ``psum`` over "ep".
+This is the dense one-hot dispatch: exact and capacity-free (no dropped
+tokens, no load-balancing loss required for correctness), at the cost
+of masked compute proportional to local experts — the classic
+capacity + all-to-all dispatch is the production scaling path and is
+deliberately out of scope here; what this module pins down is the
+sharded-expert placement, the routing math, and gradients through the
+psum combine (equivalence-tested against the unsharded reference in
+tests/test_train_experts.py).
+
+Gradient hygiene: the loss leaves the shard_map as per-cell partials
+(nonzero on ep cell 0 only) summed outside — the same
+no-replicated-outputs rule as train.pipeline, so the transpose is exact
+under check_vma=False.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+EP_AXIS = "ep"
+
+MoeParams = Dict[str, jax.Array]
+
+
+def make_ep_mesh(dp: int, ep: int, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if dp * ep > len(devices):
+        raise ValueError(f"need {dp * ep} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:dp * ep]).reshape(dp, ep),
+                (DP_AXIS, EP_AXIS))
+
+
+def init_moe(key, d_in: int, hidden: int, ffn: int, n_classes: int,
+             n_experts: int, dtype=jnp.float32) -> MoeParams:
+    ks = jax.random.split(key, 5)
+    h, f, e = hidden, ffn, n_experts
+    return {
+        "in_w": jax.random.normal(ks[0], (d_in, h), dtype)
+        * jnp.sqrt(2.0 / d_in).astype(dtype),
+        "in_b": jnp.zeros((h,), dtype),
+        "router": jax.random.normal(ks[1], (h, e), dtype)
+        * jnp.sqrt(1.0 / h).astype(dtype),
+        "up": jax.random.normal(ks[2], (e, h, f), dtype)
+        * jnp.sqrt(2.0 / h).astype(dtype),
+        "down": jax.random.normal(ks[3], (e, f, h), dtype)
+        * jnp.sqrt(2.0 / f).astype(dtype),
+        "out_w": jax.random.normal(ks[4], (h, n_classes), dtype)
+        * jnp.sqrt(2.0 / h).astype(dtype),
+        "out_b": jnp.zeros((n_classes,), dtype),
+    }
+
+
+# Single source of truth for per-param partition specs: device placement
+# (moe_param_shardings) and the shard_map in_specs both derive from it,
+# so they can never disagree.
+MOE_PSPECS = {
+    "in_w": P(None, None), "in_b": P(None),
+    "router": P(None, None),
+    "up": P(EP_AXIS, None, None),
+    "down": P(EP_AXIS, None, None),
+    "out_w": P(None, None), "out_b": P(None),
+}
+
+
+def moe_param_shardings(mesh: Mesh):
+    return {k: NamedSharding(mesh, spec) for k, spec in MOE_PSPECS.items()}
+
+
+def moe_reference_forward(params: MoeParams, x) -> jax.Array:
+    """Unsharded reference: identical math on one device (the
+    equivalence oracle). Top-1 routing, router-prob scaling, residual."""
+    h = x.astype(jnp.float32) @ params["in_w"] + params["in_b"]
+    logits = h @ params["router"]
+    probs = jax.nn.softmax(logits, -1)
+    sel = jnp.argmax(logits, -1)                        # (B,)
+    onehot = jax.nn.one_hot(sel, params["router"].shape[1],
+                            dtype=h.dtype)              # (B, E)
+    gate = jnp.sum(probs * onehot, -1, keepdims=True)   # (B, 1)
+    # Dense dispatch: every expert over every token, masked + combined.
+    up = jnp.einsum("bh,ehf->ebf", h, params["up"])
+    act = jax.nn.relu(up)
+    down = jnp.einsum("ebf,efh->ebh", act, params["down"])
+    expert_out = jnp.einsum("ebh,be->bh", down, onehot)
+    h = h + gate * expert_out                           # residual
+    return h @ params["out_w"] + params["out_b"]
+
+
+def _moe_body(params, x, y, *, n_experts: int, n_classes: int):
+    """Per-(dp, ep)-cell loss partial (inside shard_map): this cell's
+    expert slice over the dp-local tokens, psum-combined over ep."""
+    assert params["out_w"].shape[1] == n_classes, \
+        (params["out_w"].shape, n_classes)
+    ep_idx = jax.lax.axis_index(EP_AXIS)
+    e_local = params["up"].shape[0]
+    e_base = ep_idx * e_local
+
+    h = x.astype(jnp.float32) @ params["in_w"] + params["in_b"]
+    logits = h @ params["router"]
+    probs = jax.nn.softmax(logits, -1)
+    sel = jnp.argmax(logits, -1)
+    onehot = jax.nn.one_hot(sel, n_experts, dtype=h.dtype)
+    gate = jnp.sum(probs * onehot, -1, keepdims=True)
+
+    # This cell's experts only; mask selects tokens routed to them.
+    local_hot = jax.lax.dynamic_slice_in_dim(onehot, e_base, e_local, 1)
+    up = jnp.einsum("bh,ehf->ebf", h, params["up"])
+    act = jax.nn.relu(up)
+    down = jnp.einsum("ebf,efh->ebh", act, params["down"])
+    local_out = jnp.einsum("ebh,be->bh", down, local_hot)
+    expert_out = jax.lax.psum(local_out, EP_AXIS)       # combine over ep
+    h = h + gate * expert_out
+
+    out = h @ params["out_w"] + params["out_b"]
+    loss = optax.softmax_cross_entropy_with_integer_labels(out, y).mean()
+    acc = jnp.mean((jnp.argmax(out, -1) == y).astype(jnp.float32))
+    first = (ep_idx == 0).astype(loss.dtype)
+    return (loss * first)[None], (acc * first)[None]
+
+
+def make_moe_train_step(mesh: Mesh, optimizer: optax.GradientTransformation,
+                        *, n_experts: int, n_classes: int):
+    """Jitted (state, x, y) -> (state', {loss, accuracy}) over ("dp", "ep");
+    state params placed by moe_param_shardings."""
+    n_dp = mesh.devices.shape[0]
+    body = functools.partial(_moe_body, n_experts=n_experts,
+                             n_classes=n_classes)
+    sharded_loss = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(MOE_PSPECS, P(DP_AXIS, None), P(DP_AXIS)),
+        out_specs=(P((DP_AXIS, EP_AXIS)), P((DP_AXIS, EP_AXIS))),
+        check_vma=False)
+
+    def loss_fn(params, x, y):
+        loss_p, acc_p = sharded_loss(params, x, y)
+        return loss_p.sum() / n_dp, acc_p.sum() / n_dp
+
+    def step(state, x, y):
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], x, y)
+        updates, opt = optimizer.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt": opt, "step": state["step"] + 1},
+                {"loss": loss, "accuracy": acc})
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def build_moe_state(mesh: Mesh, optimizer, d_in: int, hidden: int, ffn: int,
+                    n_classes: int, n_experts: int, seed: int = 0):
+    params = init_moe(jax.random.PRNGKey(seed), d_in, hidden, ffn,
+                      n_classes, n_experts)
+    sh = moe_param_shardings(mesh)
+    placed = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+    return {"params": placed, "opt": optimizer.init(placed),
+            "step": jnp.zeros((), jnp.int32)}

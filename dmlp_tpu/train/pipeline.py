@@ -14,15 +14,17 @@ port of MPMD pipeline frameworks: ONE jitted SPMD program in which
   activation to stage s+1 via ``lax.ppermute`` over ICI — the bubble
   (ticks where m is out of range) is masked, not branched, because XLA
   wants static control flow;
-- the last stage's outputs are psum-broadcast and the loss is a
-  ``pmean`` over "dp" — plain ``jax.grad`` differentiates through the
-  scan + ppermute (XLA emits the reverse-schedule permutes), so there
-  is no hand-written backward pass.
+- the loss leaves the shard_map as per-cell PARTIALS (nonzero only on
+  each dp row's last stage) summed outside in plain math — no
+  collective touches the loss path, so the grad transpose is exact by
+  construction — and plain ``jax.grad`` differentiates through the
+  scan + ppermute (XLA emits the reverse-schedule permutes): no
+  hand-written backward pass.
 
-Input projection and readout are computed replicated on every pp cell
-(they are O(H) of the O(P * H^2) stage work); batches shard over "dp"
-with per-cell loss pmean'd, so data parallelism composes with the
-pipeline in the same program.
+Input projection and readout are computed per pp cell (they are O(H)
+of the O(P * H^2) stage work; only the cells whose values reach the
+loss contribute gradients); batches shard over "dp", so data
+parallelism composes with the pipeline in the same program.
 
 Microbatch semantics: the loss is the mean over the full (per-dp-cell)
 batch, so gradients equal the unpipelined model's — proven by the
@@ -76,15 +78,18 @@ def init_pipeline(key, d_in: int, hidden: int, n_classes: int,
     }
 
 
+# Single source of truth for per-param partition specs (placement and
+# shard_map in_specs both derive from it).
+PP_PSPECS = {
+    "in_w": P(None, None), "in_b": P(None),
+    "pp_w": P(PP_AXIS, None, None, None),
+    "pp_b": P(PP_AXIS, None, None),
+    "out_w": P(None, None), "out_b": P(None),
+}
+
+
 def pipeline_param_shardings(mesh: Mesh):
-    def sh(spec):
-        return NamedSharding(mesh, spec)
-    return {
-        "in_w": sh(P(None, None)), "in_b": sh(P(None)),
-        "pp_w": sh(P(PP_AXIS, None, None, None)),
-        "pp_b": sh(P(PP_AXIS, None, None)),
-        "out_w": sh(P(None, None)), "out_b": sh(P(None)),
-    }
+    return {k: NamedSharding(mesh, spec) for k, spec in PP_PSPECS.items()}
 
 
 def _stage_block(w, b, h):
@@ -105,6 +110,8 @@ def _pp_body(params, x, y, *, n_stages: int, n_micro: int, n_classes: int):
     ``params["pp_w"]`` arrives as this cell's (1, P, H, H) stage slice;
     x/y are this dp cell's local batch, replicated over pp.
     """
+    assert params["out_w"].shape[1] == n_classes, \
+        (params["out_w"].shape, n_classes)
     s_idx = jax.lax.axis_index(PP_AXIS)
     w_s = params["pp_w"][0]
     b_s = params["pp_b"][0]
@@ -161,19 +168,12 @@ def make_pp_train_step(mesh: Mesh, optimizer: optax.GradientTransformation,
     placed by pipeline_param_shardings."""
     n_stages = mesh.devices.shape[1]
 
-    pspecs = {
-        "in_w": P(None, None), "in_b": P(None),
-        "pp_w": P(PP_AXIS, None, None, None),
-        "pp_b": P(PP_AXIS, None, None),
-        "out_w": P(None, None), "out_b": P(None),
-    }
-
     n_dp = mesh.devices.shape[0]
     body = functools.partial(_pp_body, n_stages=n_stages, n_micro=n_micro,
                              n_classes=n_classes)
     sharded_loss = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(pspecs, P(DP_AXIS, None), P(DP_AXIS)),
+        in_specs=(PP_PSPECS, P(DP_AXIS, None), P(DP_AXIS)),
         out_specs=(P((DP_AXIS, PP_AXIS)), P((DP_AXIS, PP_AXIS))),
         check_vma=False)
 

@@ -1,0 +1,86 @@
+"""Expert-parallel MoE step vs the unsharded reference.
+
+The ep-sharded step's loss and updated params must equal a single-device
+run of the identical math (moe_reference_forward) — any dispatch-mask,
+expert-slice, psum-combine, or partial-loss bug diverges from the
+reference within f32 tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dmlp_tpu.train.experts import (build_moe_state, make_ep_mesh,
+                                    make_moe_train_step,
+                                    moe_reference_forward)
+from dmlp_tpu.train.step import make_optimizer
+
+
+def _ref_step(params, x, y, lr):
+    def loss_fn(p):
+        logits = moe_reference_forward(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    return float(loss), new
+
+
+@pytest.mark.parametrize("dp,ep", [(1, 4), (2, 2), (2, 4)])
+def test_moe_step_matches_unsharded_reference(dp, ep):
+    if len(jax.devices()) < dp * ep:
+        pytest.skip(f"needs {dp * ep} devices")
+    mesh = make_ep_mesh(dp, ep)
+    d_in, hidden, ffn, n_classes, n_experts = 6, 16, 24, 4, 8
+    lr = 0.05
+    optimizer = make_optimizer("sgd", lr, momentum=0.0)
+    state = build_moe_state(mesh, optimizer, d_in, hidden, ffn, n_classes,
+                            n_experts, seed=11)
+    ref_params = {k: jnp.asarray(np.asarray(v))
+                  for k, v in state["params"].items()}
+
+    rng = np.random.default_rng(1)
+    batch = dp * 32
+    x = rng.normal(size=(batch, d_in)).astype(np.float32)
+    y = rng.integers(0, n_classes, batch).astype(np.int32)
+
+    step = make_moe_train_step(mesh, optimizer, n_experts=n_experts,
+                               n_classes=n_classes)
+    state, m = step(state, jnp.asarray(x), jnp.asarray(y))
+
+    ref_loss, ref_new = _ref_step(ref_params, jnp.asarray(x),
+                                  jnp.asarray(y), lr)
+    assert float(m["loss"]) == pytest.approx(ref_loss, rel=1e-5)
+    for k in ref_new:
+        np.testing.assert_allclose(np.asarray(state["params"][k]),
+                                   np.asarray(ref_new[k]),
+                                   rtol=2e-4, atol=2e-6, err_msg=k)
+
+
+def test_moe_routes_to_multiple_experts_and_learns():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = make_ep_mesh(1, 4)
+    optimizer = make_optimizer("sgd", 0.05, momentum=0.5)
+    state = build_moe_state(mesh, optimizer, 8, 16, 32, 3, 4, seed=2)
+
+    rng = np.random.default_rng(3)
+    proj = rng.normal(size=(8, 3))
+    x = rng.normal(size=(96, 8)).astype(np.float32)
+    y = np.argmax(x @ proj, -1).astype(np.int32)
+
+    # Routing actually spreads over experts (not a degenerate single one).
+    ref = {k: jnp.asarray(np.asarray(v)) for k, v in state["params"].items()}
+    h = jnp.asarray(x) @ ref["in_w"] + ref["in_b"]
+    sel = np.asarray(jnp.argmax(h @ ref["router"], -1))
+    assert len(np.unique(sel)) >= 2
+
+    step = make_moe_train_step(mesh, optimizer, n_experts=4, n_classes=3)
+    losses = []
+    for _ in range(40):
+        state, m = step(state, jnp.asarray(x), jnp.asarray(y))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < 0.6 * losses[0]
