@@ -40,11 +40,8 @@ MoeParams = Dict[str, jax.Array]
 
 
 def make_ep_mesh(dp: int, ep: int, devices=None) -> Mesh:
-    devices = list(devices if devices is not None else jax.devices())
-    if dp * ep > len(devices):
-        raise ValueError(f"need {dp * ep} devices, have {len(devices)}")
-    return Mesh(np.asarray(devices[:dp * ep]).reshape(dp, ep),
-                (DP_AXIS, EP_AXIS))
+    from dmlp_tpu.train.pipeline import make_axes_mesh
+    return make_axes_mesh({DP_AXIS: dp, EP_AXIS: ep}, devices)
 
 
 def init_moe(key, d_in: int, hidden: int, ffn: int, n_classes: int,
@@ -147,26 +144,13 @@ def make_moe_train_step(mesh: Mesh, optimizer: optax.GradientTransformation,
         out_specs=(P((DP_AXIS, EP_AXIS)), P((DP_AXIS, EP_AXIS))),
         check_vma=False)
 
-    def loss_fn(params, x, y):
-        loss_p, acc_p = sharded_loss(params, x, y)
-        return loss_p.sum() / n_dp, acc_p.sum() / n_dp
-
-    def step(state, x, y):
-        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state["params"], x, y)
-        updates, opt = optimizer.update(grads, state["opt"], state["params"])
-        params = optax.apply_updates(state["params"], updates)
-        return ({"params": params, "opt": opt, "step": state["step"] + 1},
-                {"loss": loss, "accuracy": acc})
-
-    return jax.jit(step, donate_argnums=(0,))
+    from dmlp_tpu.train.pipeline import _partials_train_step
+    return _partials_train_step(sharded_loss, optimizer, n_dp)
 
 
 def build_moe_state(mesh: Mesh, optimizer, d_in: int, hidden: int, ffn: int,
                     n_classes: int, n_experts: int, seed: int = 0):
+    from dmlp_tpu.train.pipeline import place_state
     params = init_moe(jax.random.PRNGKey(seed), d_in, hidden, ffn,
                       n_classes, n_experts)
-    sh = moe_param_shardings(mesh)
-    placed = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
-    return {"params": placed, "opt": optimizer.init(placed),
-            "step": jnp.zeros((), jnp.int32)}
+    return place_state(params, moe_param_shardings(mesh), optimizer)

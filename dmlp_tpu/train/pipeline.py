@@ -49,12 +49,20 @@ PP_AXIS = "pp"
 PipeParams = Dict[str, jax.Array]
 
 
-def make_pp_mesh(dp: int, pp: int, devices=None) -> Mesh:
+def make_axes_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Mesh over the leading len(axes) devices — the one mesh builder the
+    pp/pp3/ep entry points share (axis names and sizes as an ordered
+    dict)."""
     devices = list(devices if devices is not None else jax.devices())
-    if dp * pp > len(devices):
-        raise ValueError(f"need {dp * pp} devices, have {len(devices)}")
-    return Mesh(np.asarray(devices[:dp * pp]).reshape(dp, pp),
-                (DP_AXIS, PP_AXIS))
+    total = int(np.prod(list(axes.values())))
+    if total > len(devices):
+        raise ValueError(f"need {total} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:total]).reshape(*axes.values()),
+                tuple(axes))
+
+
+def make_pp_mesh(dp: int, pp: int, devices=None) -> Mesh:
+    return make_axes_mesh({DP_AXIS: dp, PP_AXIS: pp}, devices)
 
 
 def init_pipeline(key, d_in: int, hidden: int, n_classes: int,
@@ -90,6 +98,33 @@ PP_PSPECS = {
 
 def pipeline_param_shardings(mesh: Mesh):
     return {k: NamedSharding(mesh, spec) for k, spec in PP_PSPECS.items()}
+
+
+def _partials_train_step(sharded_loss, optimizer, n_dp: int):
+    """Jitted donated train step over a partial-loss shard_map program:
+    the per-cell partials (one nonzero cell per dp row) sum to the batch
+    loss in plain math here. Shared by the 2D and 3D pipeline steps."""
+    def loss_fn(params, x, y):
+        loss_p, acc_p = sharded_loss(params, x, y)
+        return loss_p.sum() / n_dp, acc_p.sum() / n_dp
+
+    def step(state, x, y):
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], x, y)
+        updates, opt = optimizer.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt": opt, "step": state["step"] + 1},
+                {"loss": loss, "accuracy": acc})
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def place_state(params, shardings, optimizer):
+    """device_put params per sharding table; moments inherit placement.
+    Shared by the pipeline and MoE state builders."""
+    placed = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+    return {"params": placed, "opt": optimizer.init(placed),
+            "step": jnp.zeros((), jnp.int32)}
 
 
 def _stage_block(w, b, h):
@@ -177,21 +212,7 @@ def make_pp_train_step(mesh: Mesh, optimizer: optax.GradientTransformation,
         out_specs=(P((DP_AXIS, PP_AXIS)), P((DP_AXIS, PP_AXIS))),
         check_vma=False)
 
-    def loss_fn(params, x, y):
-        # (dp * pp,) partials, one nonzero per dp row (its last stage);
-        # mean over dp rows happens here in plain math.
-        loss_p, acc_p = sharded_loss(params, x, y)
-        return loss_p.sum() / n_dp, acc_p.sum() / n_dp
-
-    def step(state, x, y):
-        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state["params"], x, y)
-        updates, opt = optimizer.update(grads, state["opt"], state["params"])
-        params = optax.apply_updates(state["params"], updates)
-        return ({"params": params, "opt": opt, "step": state["step"] + 1},
-                {"loss": loss, "accuracy": acc})
-
-    return jax.jit(step, donate_argnums=(0,))
+    return _partials_train_step(sharded_loss, optimizer, n_dp)
 
 
 def build_pp_state(mesh: Mesh, optimizer, d_in: int, hidden: int,
@@ -200,10 +221,7 @@ def build_pp_state(mesh: Mesh, optimizer, d_in: int, hidden: int,
     stages = mesh.devices.shape[1]
     params = init_pipeline(jax.random.PRNGKey(seed), d_in, hidden,
                            n_classes, stages, layers_per_stage)
-    sh = pipeline_param_shardings(mesh)
-    placed = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
-    return {"params": placed, "opt": optimizer.init(placed),
-            "step": jnp.zeros((), jnp.int32)}
+    return place_state(params, pipeline_param_shardings(mesh), optimizer)
 
 
 def flatten_pipeline(params: PipeParams) -> Tuple:
@@ -224,3 +242,153 @@ def flat_forward(flat, x):
     for wi, bi in zip(ws, bs):
         h = jax.nn.relu(h @ wi + bi)
     return h @ out_w + out_b
+
+
+# ---------------------------------------------------------------------------
+# 3D composition: dp x tp x pp in one jit. Stage layers come in Megatron
+# col/row pairs — the column-split matmul shards its OUTPUT dim over "tp",
+# the row-split one its INPUT dim, so each pair needs exactly one tp psum —
+# while the pp schedule (scan + ppermute) and the dp batch split are
+# unchanged from the 2D form above. Grad-exact vs the flat stack
+# (tests/test_train_pipeline.py::test_pp3_step_matches_flat_reference).
+# ---------------------------------------------------------------------------
+
+TP_AXIS = "tp"
+
+PP3_PSPECS = {
+    "in_w": P(None, None), "in_b": P(None),
+    # column-parallel: output dim tp-sharded (bias follows its output)
+    "wc": P(PP_AXIS, None, None, TP_AXIS),
+    "bc": P(PP_AXIS, None, TP_AXIS),
+    # row-parallel: input dim tp-sharded; bias replicated (added after psum)
+    "wr": P(PP_AXIS, None, TP_AXIS, None),
+    "br": P(PP_AXIS, None, None),
+    "out_w": P(None, None), "out_b": P(None),
+}
+
+
+def make_pp3_mesh(dp: int, tp: int, pp: int, devices=None) -> Mesh:
+    return make_axes_mesh({DP_AXIS: dp, TP_AXIS: tp, PP_AXIS: pp}, devices)
+
+
+def init_pipeline3(key, d_in: int, hidden: int, n_classes: int,
+                   stages: int, pairs_per_stage: int,
+                   dtype=jnp.float32) -> PipeParams:
+    """Col/row layer pairs per stage: h -> relu(h@Wc + bc) -> @Wr (+psum)
+    -> relu(+br)."""
+    ks = jax.random.split(key, 4)
+    s, p2, h = stages, pairs_per_stage, hidden
+    scale = jnp.sqrt(2.0 / h).astype(dtype)
+    return {
+        "in_w": jax.random.normal(ks[0], (d_in, h), dtype)
+        * jnp.sqrt(2.0 / d_in).astype(dtype),
+        "in_b": jnp.zeros((h,), dtype),
+        "wc": jax.random.normal(ks[1], (s, p2, h, h), dtype) * scale,
+        "bc": jnp.zeros((s, p2, h), dtype),
+        "wr": jax.random.normal(ks[2], (s, p2, h, h), dtype) * scale,
+        "br": jnp.zeros((s, p2, h), dtype),
+        "out_w": jax.random.normal(ks[3], (h, n_classes), dtype)
+        * jnp.sqrt(2.0 / h).astype(dtype),
+        "out_b": jnp.zeros((n_classes,), dtype),
+    }
+
+
+def pipeline3_param_shardings(mesh: Mesh):
+    return {k: NamedSharding(mesh, spec) for k, spec in PP3_PSPECS.items()}
+
+
+def _stage_block3(wc, bc, wr, br, h):
+    """One stage's col/row pairs on this tp cell's shard: wc (P2, H, Hl),
+    wr (P2, Hl, H); one tp psum per pair."""
+    def pair(h, wb):
+        wci, bci, wri, bri = wb
+        u = jax.nn.relu(
+            jax.lax.dot_general(h, wci, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32) + bci)
+        v = jax.lax.dot_general(u, wri, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        v = jax.lax.psum(v, TP_AXIS)
+        return jax.nn.relu(v + bri).astype(h.dtype), None
+    h, _ = jax.lax.scan(pair, h, (wc, bc, wr, br))
+    return h
+
+
+def _pp3_body(params, x, y, *, n_stages: int, n_micro: int, n_classes: int):
+    """Per-(dp, tp, pp)-cell pipelined loss partial."""
+    assert params["out_w"].shape[1] == n_classes
+    s_idx = jax.lax.axis_index(PP_AXIS)
+    t_idx = jax.lax.axis_index(TP_AXIS)
+    wc, bc = params["wc"][0], params["bc"][0]
+    wr, br = params["wr"][0], params["br"][0]
+
+    h0 = x.astype(jnp.float32) @ params["in_w"] + params["in_b"]
+    mb = h0.shape[0] // n_micro
+    h_mb = h0.reshape(n_micro, mb, -1)
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        act, ys = carry
+        m = t - s_idx
+        fresh = h_mb[jnp.clip(t, 0, n_micro - 1)]
+        inp = jnp.where(s_idx == 0, fresh, act)
+        out = _stage_block3(wc, bc, wr, br, inp)
+        take = (s_idx == n_stages - 1) & (m >= 0) & (m < n_micro)
+        ys = jnp.where(
+            take,
+            jax.lax.dynamic_update_index_in_dim(
+                ys, out, jnp.clip(m, 0, n_micro - 1), 0),
+            ys)
+        act = jax.lax.ppermute(out, PP_AXIS, perm) if n_stages > 1 else out
+        return (act, ys), None
+
+    (_, ys), _ = jax.lax.scan(
+        tick, (jnp.zeros_like(h_mb[0]), jnp.zeros_like(h_mb)),
+        jnp.arange(n_micro + n_stages - 1))
+
+    logits = ys.reshape(h0.shape) @ params["out_w"] + params["out_b"]
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+    # Partial nonzero on exactly one (tp, pp) cell per dp row: the same
+    # no-collective-on-the-loss-path rule as the 2D form. (Every tp cell
+    # of the last stage holds identical post-psum activations; only tp 0
+    # reports.)
+    mine = ((s_idx == n_stages - 1) & (t_idx == 0)).astype(loss.dtype)
+    return (loss * mine)[None], (acc * mine)[None]
+
+
+def make_pp3_train_step(mesh: Mesh, optimizer: optax.GradientTransformation,
+                        *, n_micro: int, n_classes: int):
+    """Jitted (state, x, y) -> (state', metrics) over ("dp", "tp", "pp")."""
+    n_dp, _n_tp, n_stages = mesh.devices.shape
+    body = functools.partial(_pp3_body, n_stages=n_stages, n_micro=n_micro,
+                             n_classes=n_classes)
+    sharded_loss = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(PP3_PSPECS, P(DP_AXIS, None), P(DP_AXIS)),
+        out_specs=(P((DP_AXIS, TP_AXIS, PP_AXIS)),
+                   P((DP_AXIS, TP_AXIS, PP_AXIS))),
+        check_vma=False)
+
+    return _partials_train_step(sharded_loss, optimizer, n_dp)
+
+
+def build_pp3_state(mesh: Mesh, optimizer, d_in: int, hidden: int,
+                    n_classes: int, pairs_per_stage: int, seed: int = 0):
+    stages = mesh.devices.shape[2]
+    params = init_pipeline3(jax.random.PRNGKey(seed), d_in, hidden,
+                            n_classes, stages, pairs_per_stage)
+    return place_state(params, pipeline3_param_shardings(mesh), optimizer)
+
+
+def pp3_reference_forward(params: PipeParams, x) -> jax.Array:
+    """Unsharded reference for the 3D step (equivalence oracle)."""
+    h = x.astype(jnp.float32) @ params["in_w"] + params["in_b"]
+    s, p2 = params["wc"].shape[:2]
+    wc = params["wc"].reshape(s * p2, *params["wc"].shape[2:])
+    bc = params["bc"].reshape(s * p2, -1)
+    wr = params["wr"].reshape(s * p2, *params["wr"].shape[2:])
+    br = params["br"].reshape(s * p2, -1)
+    for i in range(s * p2):
+        u = jax.nn.relu(h @ wc[i] + bc[i])
+        h = jax.nn.relu(u @ wr[i] + br[i])
+    return h @ params["out_w"] + params["out_b"]
