@@ -78,33 +78,98 @@ def build_sharded_state(mesh, dims, optimizer, seed: int = 0,
     return state
 
 
+def _build_parallel(parallelism: str, mesh_shape, dims, optimizer,
+                    compute_dtype, offload, seed: int, n_micro: int,
+                    n_experts: int):
+    """(mesh, state, step_fn, data_dims) for the chosen parallelism
+    family. "dp_tp" is the full-featured default (offload levels, compute
+    dtype); "dp_pp"/"dp_pp3"/"dp_ep" run the pipeline/MoE steps — their
+    mesh comes from --mesh (DP,PP / DP,TP,PP / DP,EP), dims are
+    (in, hidden, classes) for the pipelines (layers spread uniformly over
+    stages, 2 per stage) and (in, hidden, ffn, classes) for the MoE."""
+    if parallelism == "dp_tp":
+        mesh = make_train_mesh(mesh_shape)
+        offload = resolve_offload_level(offload)
+        state = build_sharded_state(mesh, dims, optimizer, seed,
+                                    offload=offload)
+        cdtype = jnp.bfloat16 if compute_dtype == "bfloat16" else None
+        if offload != "none":
+            from dmlp_tpu.train.step import make_offload_train_step
+            step_fn = make_offload_train_step(optimizer, cdtype, state)
+        else:
+            step_fn = make_train_step(optimizer, cdtype)
+        return mesh, state, step_fn, (dims[0], dims[-1])
+
+    # The pipeline/MoE families run f32 without host offload; silently
+    # ignoring these flags would misattribute benchmark numbers.
+    if compute_dtype is not None:
+        raise ValueError(f"--compute-dtype applies to dp_tp only, "
+                         f"not {parallelism}")
+    if resolve_offload_level(offload) != "none":
+        raise ValueError(f"--offload applies to dp_tp only, "
+                         f"not {parallelism}")
+
+    if parallelism in ("dp_pp", "dp_pp3"):
+        from dmlp_tpu.train import pipeline as pl
+        if len(dims) != 3:
+            raise ValueError(f"{parallelism} wants --dims in,hidden,classes")
+        d_in, hidden, n_classes = dims
+        if parallelism == "dp_pp":
+            dp, pp = mesh_shape or (1, len(jax.devices()))
+            mesh = pl.make_pp_mesh(dp, pp)
+            state = pl.build_pp_state(mesh, optimizer, d_in, hidden,
+                                      n_classes, 2, seed=seed)
+            step_fn = pl.make_pp_train_step(mesh, optimizer, n_micro=n_micro,
+                                            n_classes=n_classes)
+        else:
+            dp, tp, pp = mesh_shape or (1, 2, len(jax.devices()) // 2)
+            mesh = pl.make_pp3_mesh(dp, tp, pp)
+            state = pl.build_pp3_state(mesh, optimizer, d_in, hidden,
+                                       n_classes, 2, seed=seed)
+            step_fn = pl.make_pp3_train_step(mesh, optimizer,
+                                             n_micro=n_micro,
+                                             n_classes=n_classes)
+        return mesh, state, step_fn, (d_in, n_classes)
+
+    if parallelism == "dp_ep":
+        from dmlp_tpu.train import experts as ex
+        if len(dims) != 4:
+            raise ValueError("dp_ep wants --dims in,hidden,ffn,classes")
+        d_in, hidden, ffn, n_classes = dims
+        dp, ep = mesh_shape or (1, len(jax.devices()))
+        mesh = ex.make_ep_mesh(dp, ep)
+        state = ex.build_moe_state(mesh, optimizer, d_in, hidden, ffn,
+                                   n_classes, n_experts, seed=seed)
+        step_fn = ex.make_moe_train_step(mesh, optimizer,
+                                         n_experts=n_experts,
+                                         n_classes=n_classes)
+        return mesh, state, step_fn, (d_in, n_classes)
+
+    raise ValueError(f"unknown parallelism {parallelism!r}")
+
+
 def train(steps: int = 100, batch: int = 1024,
           dims: Sequence[int] = (64, 256, 256, 10),
           mesh_shape=None, optimizer_name: str = "sgd", lr: float = 1e-2,
           compute_dtype: Optional[str] = None, seed: int = 0,
           checkpoint_dir: Optional[str] = None, ckpt_every: int = 100,
           resume: bool = False, metrics: Optional[MetricsLogger] = None,
-          log_every: int = 10, offload=False):
-    mesh = make_train_mesh(mesh_shape)
-    n_chips = mesh.devices.size
+          log_every: int = 10, offload=False, parallelism: str = "dp_tp",
+          n_micro: int = 4, n_experts: int = 8):
     optimizer = make_optimizer(optimizer_name, lr)
-    offload = resolve_offload_level(offload)
-    state = build_sharded_state(mesh, dims, optimizer, seed, offload=offload)
+    mesh, state, step_fn, (d_in, n_classes) = _build_parallel(
+        parallelism, mesh_shape, tuple(dims), optimizer, compute_dtype,
+        offload, seed, n_micro, n_experts)
+    n_chips = mesh.devices.size
     start_step = 0
     if resume and checkpoint_dir and ckpt_lib.latest_step(checkpoint_dir) is not None:
         state = ckpt_lib.restore_checkpoint(checkpoint_dir, state)
         start_step = int(jax.device_get(state["step"]))
 
-    cdtype = jnp.bfloat16 if compute_dtype == "bfloat16" else None
-    if offload != "none":
-        from dmlp_tpu.train.step import make_offload_train_step
-        step_fn = make_offload_train_step(optimizer, cdtype, state)
-    else:
-        step_fn = make_train_step(optimizer, cdtype)
     shardings = batch_shardings(mesh)
     from dmlp_tpu.train.data import prefetch_to_device
     data = prefetch_to_device(
-        teacher_batches(dims[0], dims[-1], batch, seed=seed + 1), shardings)
+        teacher_batches(d_in, n_classes, batch, seed=seed + 1), shardings)
 
     last = {}
     t_window = time.perf_counter()
@@ -136,8 +201,21 @@ def main(argv=None) -> int:
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch", type=int, default=1024)
     p.add_argument("--dims", type=str, default="64,256,256,10",
-                   help="comma-separated layer dims: in,hidden...,classes")
-    p.add_argument("--mesh", type=str, default=None, help="DP,TP")
+                   help="comma-separated layer dims: in,hidden...,classes "
+                        "(dp_pp/dp_pp3: in,hidden,classes; dp_ep: "
+                        "in,hidden,ffn,classes)")
+    p.add_argument("--mesh", type=str, default=None,
+                   help="DP,TP (dp_tp) / DP,PP (dp_pp) / DP,TP,PP "
+                        "(dp_pp3) / DP,EP (dp_ep)")
+    p.add_argument("--parallelism", default="dp_tp",
+                   choices=["dp_tp", "dp_pp", "dp_pp3", "dp_ep"],
+                   help="mesh-parallelism family: dp x tp MLP (default; "
+                        "full feature set), dp x pp / dp x tp x pp "
+                        "pipelined stack, dp x ep MoE")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="pipeline microbatches per step (dp_pp/dp_pp3)")
+    p.add_argument("--experts", type=int, default=8,
+                   help="MoE expert count (dp_ep; divisible by EP)")
     p.add_argument("--optimizer", default="sgd", choices=["sgd", "adam"])
     p.add_argument("--lr", type=float, default=1e-2)
     p.add_argument("--compute-dtype", default=None,
@@ -158,8 +236,7 @@ def main(argv=None) -> int:
 
     mesh_shape = None
     if args.mesh:
-        dp, tp = args.mesh.split(",")
-        mesh_shape = (int(dp), int(tp))
+        mesh_shape = tuple(int(d) for d in args.mesh.split(","))
     metrics = MetricsLogger(path=args.metrics_file) \
         if args.metrics_file else MetricsLogger()
     _, last = train(
@@ -169,7 +246,8 @@ def main(argv=None) -> int:
         compute_dtype=args.compute_dtype, seed=args.seed,
         checkpoint_dir=args.checkpoint_dir, ckpt_every=args.ckpt_every,
         resume=args.resume, metrics=metrics, log_every=args.log_every,
-        offload=args.offload)
+        offload=args.offload, parallelism=args.parallelism,
+        n_micro=args.microbatches, n_experts=args.experts)
     print(f"final: {last}")
     return 0
 

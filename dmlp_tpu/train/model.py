@@ -57,5 +57,23 @@ def mlp_apply(params: Params, x: jax.Array,
 
 
 def num_matmul_params(params: Any) -> int:
-    """Total weight-matrix elements (for the 6*N*B FLOP estimate)."""
+    """Total weight-matrix elements (for the 6*N*B FLOP estimate).
+
+    Dispatches on the param-family layout: the dp x tp MLP
+    (dict-of-layers with "w"), the pipeline families (stacked "pp_w" or
+    "wc"/"wr"), and the MoE family ("up"/"down" expert stacks + router).
+    MoE counts ALL expert elements — the dense-dispatch step really
+    multiplies by every expert — so its MFU stays honest for the
+    implementation as built.
+    """
+    if "pp_w" in params:
+        return int(params["in_w"].size + params["pp_w"].size
+                   + params["out_w"].size)
+    if "wc" in params:
+        return int(params["in_w"].size + params["wc"].size
+                   + params["wr"].size + params["out_w"].size)
+    if "up" in params:
+        return int(params["in_w"].size + params["router"].size
+                   + params["up"].size + params["down"].size
+                   + params["out_w"].size)
     return sum(int(v["w"].size) for v in params.values())

@@ -54,6 +54,8 @@ def make_axes_mesh(axes: Dict[str, int], devices=None) -> Mesh:
     pp/pp3/ep entry points share (axis names and sizes as an ordered
     dict)."""
     devices = list(devices if devices is not None else jax.devices())
+    if any(v < 1 for v in axes.values()):
+        raise ValueError(f"mesh axes must be >= 1, got {axes}")
     total = int(np.prod(list(axes.values())))
     if total > len(devices):
         raise ValueError(f"need {total} devices, have {len(devices)}")
@@ -121,10 +123,15 @@ def _partials_train_step(sharded_loss, optimizer, n_dp: int):
 
 def place_state(params, shardings, optimizer):
     """device_put params per sharding table; moments inherit placement.
-    Shared by the pipeline and MoE state builders."""
+    Shared by the pipeline and MoE state builders. The step counter is
+    placed replicated on the same mesh — a default-device scalar would
+    make the jit reject mesh-committed batch arguments as an
+    incompatible device set."""
     placed = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
-    return {"params": placed, "opt": optimizer.init(placed),
-            "step": jnp.zeros((), jnp.int32)}
+    mesh = next(iter(shardings.values())).mesh
+    step0 = jax.device_put(jnp.zeros((), jnp.int32),
+                           NamedSharding(mesh, P()))
+    return {"params": placed, "opt": optimizer.init(placed), "step": step0}
 
 
 def _stage_block(w, b, h):

@@ -220,3 +220,44 @@ def test_resolve_offload_level_env_style():
 
     assert resolve_offload_level("1") == "all"
     assert resolve_offload_level("0") == "none"
+
+
+def test_train_loop_parallelism_families(tmp_path):
+    """The production loop CLI path drives every mesh-parallelism family:
+    dp_pp, dp_pp3, and dp_ep train with finite decreasing-ish loss and
+    checkpoint/resume round-trips on the pipelined state."""
+    state, last = train(steps=8, batch=32, dims=(8, 16, 3),
+                        mesh_shape=(1, 4), lr=0.05, log_every=8,
+                        parallelism="dp_pp", n_micro=2,
+                        checkpoint_dir=str(tmp_path / "ck"), ckpt_every=8)
+    assert np.isfinite(last["loss"])
+    assert state["params"]["pp_w"].sharding.spec[0] == "pp"
+
+    # resume continues the step counter on the pipelined state
+    state2, last2 = train(steps=4, batch=32, dims=(8, 16, 3),
+                          mesh_shape=(1, 4), lr=0.05, log_every=4,
+                          parallelism="dp_pp", n_micro=2,
+                          checkpoint_dir=str(tmp_path / "ck"), resume=True)
+    assert last2["step"] == 12
+
+    _, last3 = train(steps=6, batch=32, dims=(8, 16, 3),
+                     mesh_shape=(1, 2, 2), lr=0.05, log_every=6,
+                     parallelism="dp_pp3", n_micro=2)
+    assert np.isfinite(last3["loss"])
+
+    _, last4 = train(steps=6, batch=32, dims=(8, 16, 24, 3),
+                     mesh_shape=(1, 4), lr=0.05, log_every=6,
+                     parallelism="dp_ep", n_experts=4)
+    assert np.isfinite(last4["loss"])
+
+
+def test_train_loop_rejects_inapplicable_flags():
+    with pytest.raises(ValueError, match="compute-dtype"):
+        train(steps=1, batch=8, dims=(4, 8, 2), mesh_shape=(1, 2),
+              parallelism="dp_pp", compute_dtype="bfloat16")
+    with pytest.raises(ValueError, match="offload"):
+        train(steps=1, batch=8, dims=(4, 8, 2), mesh_shape=(1, 2),
+              parallelism="dp_pp", offload="all")
+    from dmlp_tpu.train.pipeline import make_axes_mesh
+    with pytest.raises(ValueError, match=">= 1"):
+        make_axes_mesh({"dp": 1, "pp": 0})
