@@ -1,13 +1,22 @@
 """Training-loop CLI: the north-star benchmark entry point.
 
-Runs the dp x tp sharded train step over a device mesh with JSON metrics
+Runs a sharded train step over a device mesh with JSON metrics
 (samples/sec/chip, MFU — BASELINE.json's metric set) and orbax
-checkpoint/resume. Usage::
+checkpoint/resume. ``--parallelism`` picks the mesh family: the dp x tp
+MLP (default; offload ladder + compute dtype), the dp x pp /
+dp x tp x pp pipelined stack, or the dp x ep MoE. Usage::
 
     python -m dmlp_tpu.train.loop --steps 200 --batch 4096 \
         --dims 64,512,512,10 [--mesh DP,TP] [--optimizer sgd|adam]
-        [--compute-dtype bfloat16] [--checkpoint-dir ckpt --ckpt-every 100]
-        [--resume] [--metrics-file metrics.jsonl]
+        [--compute-dtype bfloat16] [--offload [none|params|all]]
+        [--checkpoint-dir ckpt --ckpt-every 100] [--resume]
+        [--metrics-file metrics.jsonl]
+    python -m dmlp_tpu.train.loop --parallelism dp_pp  --mesh 2,4 \
+        --dims 64,256,10 --microbatches 8
+    python -m dmlp_tpu.train.loop --parallelism dp_pp3 --mesh 1,2,4 \
+        --dims 64,256,10
+    python -m dmlp_tpu.train.loop --parallelism dp_ep  --mesh 2,4 \
+        --dims 64,256,512,10 --experts 8
 """
 
 from __future__ import annotations
