@@ -7,14 +7,19 @@ extension surface, built SPMD: expert weights are stacked (E, H, F) /
 (E, F, H) and sharded over "ep" so each cell holds E/ep experts; inside
 ``shard_map`` every cell computes its LOCAL experts over all (per-dp)
 tokens under the routing mask and the contributions ``psum`` over "ep".
-This is the dense one-hot dispatch: exact and capacity-free (no dropped
-tokens, no load-balancing loss required for correctness), at the cost
-of masked compute proportional to local experts — the classic
-capacity + all-to-all dispatch is the production scaling path and is
-deliberately out of scope here; what this module pins down is the
-sharded-expert placement, the routing math, and gradients through the
-psum combine (equivalence-tested against the unsharded reference in
-tests/test_train_experts.py).
+Two dispatches are provided:
+
+- DENSE one-hot (make_moe_train_step): exact and capacity-free (no
+  dropped tokens), at the cost of masked compute proportional to local
+  experts — the semantics-pinning form.
+- CAPACITY + ALL-TO-ALL (make_moe_a2a_train_step): the production
+  scaling form — tokens shard over BOTH mesh axes, route to the
+  expert-owning cells via ``lax.all_to_all`` over ICI, and tokens
+  beyond ``capacity`` per (source, destination) pair drop to the
+  residual path. With capacity >= local tokens it is grad-exact vs the
+  unsharded reference; drop semantics are pinned by a drop-aware test.
+
+Both are equivalence-tested in tests/test_train_experts.py.
 
 Gradient hygiene: the loss leaves the shard_map as per-cell partials
 (nonzero on ep cell 0 only) summed outside — the same
@@ -154,3 +159,117 @@ def build_moe_state(mesh: Mesh, optimizer, d_in: int, hidden: int, ffn: int,
     params = init_moe(jax.random.PRNGKey(seed), d_in, hidden, ffn,
                       n_classes, n_experts)
     return place_state(params, moe_param_shardings(mesh), optimizer)
+
+
+# ---------------------------------------------------------------------------
+# Production-style dispatch: capacity + all-to-all. Tokens shard over BOTH
+# mesh axes (batch split dp x ep); each cell routes its local tokens to the
+# expert-owning ep cells through lax.all_to_all over ICI, computes its own
+# experts on what arrives, and returns results through the reverse
+# all_to_all. Tokens beyond `capacity` per (source cell, destination cell)
+# are dropped to the residual path — the standard MoE capacity semantics.
+# With capacity >= local tokens nothing drops and the step is grad-exact vs
+# moe_reference_forward (tests/test_train_experts.py).
+# ---------------------------------------------------------------------------
+
+
+def _moe_a2a_body(params, x, y, *, n_experts: int, n_classes: int,
+                  capacity: int, batch_global: int):
+    ep_idx = jax.lax.axis_index(EP_AXIS)
+    n_ep = jax.lax.axis_size(EP_AXIS)
+    e_local = params["up"].shape[0]
+    bl, hdim = x.shape[0], params["in_w"].shape[1]
+
+    h = x.astype(jnp.float32) @ params["in_w"] + params["in_b"]
+    logits = h @ params["router"]
+    probs = jax.nn.softmax(logits, -1)
+    sel = jnp.argmax(logits, -1)                         # (Bl,) global id
+    gate = jnp.take_along_axis(probs, sel[:, None], 1)   # (Bl, 1)
+
+    dest = sel // e_local                                # owning ep cell
+    e_loc = sel % e_local
+    # Rank of each token within its destination group (position order).
+    hot = (dest[:, None] == jnp.arange(n_ep)[None, :]).astype(jnp.int32)
+    rank = jnp.take_along_axis(jnp.cumsum(hot, 0) - 1, dest[:, None],
+                               1)[:, 0]                  # (Bl,)
+    kept = rank < capacity
+
+    # Scatter local tokens into the (n_ep, C, H) send buffer; slot payload
+    # carries the expert-local id (+1; 0 = empty slot) alongside.
+    send = jnp.zeros((n_ep, capacity, hdim), h.dtype)
+    meta = jnp.zeros((n_ep, capacity), jnp.int32)
+    # Dropped tokens scatter OUT OF RANGE so mode="drop" discards them —
+    # aiming them at slot (0, 0) would clobber the real rank-0 token of
+    # destination 0 with zeros.
+    di = jnp.where(kept, dest, n_ep)
+    ri = jnp.where(kept, rank, capacity)
+    send = send.at[di, ri].set(h, mode="drop")
+    meta = meta.at[di, ri].set(e_loc + 1, mode="drop")
+
+    # Dispatch over ICI: slot [s, c] on this cell is now source cell s's
+    # c-th token destined to OUR experts.
+    recv = jax.lax.all_to_all(send, EP_AXIS, 0, 0)
+    rmeta = jax.lax.all_to_all(meta, EP_AXIS, 0, 0)
+
+    toks = recv.reshape(n_ep * capacity, hdim)
+    tmeta = rmeta.reshape(n_ep * capacity)
+    ehot = jax.nn.one_hot(tmeta - 1, e_local, dtype=toks.dtype)
+    ehot = ehot * (tmeta > 0)[:, None]                   # empty slots -> 0
+    up = jnp.einsum("th,ehf->tef", toks, params["up"])
+    act = jax.nn.relu(up)
+    down = jnp.einsum("tef,efh->teh", act, params["down"])
+    out_toks = jnp.einsum("teh,te->th", down, ehot)
+
+    # Return through the reverse all_to_all (same slot layout back).
+    ret = jax.lax.all_to_all(
+        out_toks.reshape(n_ep, capacity, hdim), EP_AXIS, 0, 0)
+    # Gather back with in-range indices (dropped tokens read slot (0, 0)
+    # and are masked to the residual-only path).
+    expert_out = jnp.where(kept[:, None],
+                           ret[jnp.where(kept, dest, 0),
+                               jnp.where(kept, rank, 0)], 0.0)
+
+    h = h + gate * expert_out
+    out = h @ params["out_w"] + params["out_b"]
+    ce = optax.softmax_cross_entropy_with_integer_labels(out, y)
+    acc = (jnp.argmax(out, -1) == y).astype(jnp.float32)
+    # Per-cell SUM partials; the caller divides by the global batch — the
+    # same no-collective-on-the-loss-path rule as the dense dispatch.
+    del batch_global
+    return ce.sum()[None], acc.sum()[None]
+
+
+def make_moe_a2a_train_step(mesh: Mesh,
+                            optimizer: optax.GradientTransformation, *,
+                            n_experts: int, n_classes: int, capacity: int):
+    """Jitted capacity + all-to-all MoE step over ("dp", "ep"): the batch
+    splits over BOTH axes (x arrives P(("dp","ep"), None)); per-cell CE
+    sums are divided by the global batch size outside the shard_map."""
+    if capacity < 1:
+        raise ValueError("capacity must be >= 1 (0 would make zero-width "
+                         "dispatch buffers; to drop everything, don't run "
+                         "the experts)")
+    body = functools.partial(_moe_a2a_body, n_experts=n_experts,
+                             n_classes=n_classes, capacity=capacity,
+                             batch_global=0)
+    sharded_loss = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(MOE_PSPECS, P((DP_AXIS, EP_AXIS), None),
+                  P((DP_AXIS, EP_AXIS))),
+        out_specs=(P((DP_AXIS, EP_AXIS)), P((DP_AXIS, EP_AXIS))),
+        check_vma=False)
+
+    def loss_fn(params, x, y):
+        loss_p, acc_p = sharded_loss(params, x, y)
+        b = x.shape[0]
+        return loss_p.sum() / b, acc_p.sum() / b
+
+    def step(state, x, y):
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], x, y)
+        updates, opt = optimizer.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt": opt, "step": state["step"] + 1},
+                {"loss": loss, "accuracy": acc})
+
+    return jax.jit(step, donate_argnums=(0,))
