@@ -115,15 +115,6 @@ class EngineConfig:
             return "float32"
         return "bfloat16" if platform == "tpu" else "float32"
 
-    def resolve_np_dtype(self):
-        """NumPy dtype for host staging buffers (the wire form of
-        resolve_dtype) — single definition for every staging site."""
-        if self.resolve_dtype() == "bfloat16":
-            import ml_dtypes
-            return ml_dtypes.bfloat16
-        import numpy as np
-        return np.float32
-
     def resolve_select(self, padded_rows: int) -> str:
         """Concrete selection strategy for a dataset of ``padded_rows``."""
         if self.select != "auto":

@@ -54,6 +54,19 @@ def _chunk_span(sc, ck: int):
     return id_base, n_real
 
 
+def _np_staging_dtype(staging: str):
+    """Host wire dtype for the engine's CURRENT staging state. Staging
+    sites must read this (via ShardedEngine._np_dtype), never re-resolve
+    the config (config.resolve_dtype): that maps dtype="auto" back to
+    bfloat16 on TPU even while no_auto_coarsen has swapped the engine to
+    float32 for a device-full run, which would silently stage bf16 under
+    a float32 ordering contract."""
+    if staging == "bfloat16":
+        import ml_dtypes
+        return ml_dtypes.bfloat16
+    return np.float32
+
+
 def _labels_for_ids(ids, lab_g):
     """Gather labels for global ids (-1 stays -1) from the replicated
     label vector — shared by the chunk merge and the outlier fold."""
@@ -77,6 +90,11 @@ class ShardedEngine:
         self.last_phase_ms: Dict[str, float] = {}
         self.last_hetk = None  # (bulk, outlier) counts when routing split
 
+    def _np_dtype(self):
+        """Wire dtype from the engine's (possibly no_auto_coarsen-swapped)
+        staging state — see _np_staging_dtype."""
+        return _np_staging_dtype(self._staging)
+
     # -- sharded placement ---------------------------------------------------
     def _shard_inputs(self, inp: KNNInput, data_block: int, qgran: int = 8):
         r, c = self.mesh.devices.shape
@@ -95,7 +113,7 @@ class ShardedEngine:
         # jnp.asarray first would land the full array on the default device
         # and reshard from there — a second full copy, and on a tunneled
         # host link a second full transfer.
-        np_dtype = self.config.resolve_np_dtype()
+        np_dtype = self._np_dtype()
         return (jax.device_put(attrs.astype(np_dtype, copy=False), dsh),
                 jax.device_put(labels, dsh1),
                 jax.device_put(ids, dsh1),
@@ -398,7 +416,7 @@ class ShardedEngine:
             self.last_hetk = (int(bulk_idx.size), int(out_idx.size))
 
         t0 = _time.perf_counter()
-        np_dtype = self.config.resolve_np_dtype()
+        np_dtype = self._np_dtype()
         qsh = NamedSharding(self.mesh, P(QUERY_AXIS, None))
         csh = NamedSharding(self.mesh, P(DATA_AXIS, None))
         rsh = NamedSharding(self.mesh, P())

@@ -86,3 +86,39 @@ def dryrun_train(devices: Sequence[jax.Device]) -> None:
             moe_reference_forward(ref, jnp.asarray(xb)),
             jnp.asarray(yb)).mean())
         np.testing.assert_allclose(float(em["loss"]), ew, rtol=2e-5)
+
+        # Production capacity + all-to-all MoE dispatch (VERDICT r4 item
+        # 1/4): capacity = local tokens (a2a_capacity with cf = ep) means
+        # zero drops, so the loss must match the SAME unsharded reference
+        # the dense dispatch was checked against.
+        from dmlp_tpu.train.experts import (a2a_batch_shardings,
+                                            a2a_capacity,
+                                            make_moe_a2a_train_step)
+        bt = xb.shape[0]
+        cap = a2a_capacity(bt, dp_pp, 4, capacity_factor=4.0)
+        assert cap >= bt // (dp_pp * 4), (cap, bt)  # zero-drop regime
+        astate = build_moe_state(emesh, optimizer, 6, 16, 24, 4, 8, seed=9)
+        astep = make_moe_a2a_train_step(emesh, optimizer, n_experts=8,
+                                        n_classes=4, capacity=cap)
+        xsh_a, ysh_a = a2a_batch_shardings(emesh)
+        astate, am = astep(astate, jax.device_put(jnp.asarray(xb), xsh_a),
+                           jax.device_put(jnp.asarray(yb), ysh_a))
+        np.testing.assert_allclose(float(am["loss"]), ew, rtol=2e-5)
+
+        # 3D dp x tp x pp composition (VERDICT r4 item 4): one microbatched
+        # step over the (dp, 2, 2) mesh vs the unpipelined, unsharded
+        # reference forward.
+        from dmlp_tpu.train.pipeline import (build_pp3_state, make_pp3_mesh,
+                                             make_pp3_train_step,
+                                             pp3_reference_forward)
+        p3mesh = make_pp3_mesh(dp_pp, 2, 2, devices=devices)
+        p3state = build_pp3_state(p3mesh, optimizer, 6, 16, 4, 2, seed=13)
+        p3ref = {k: jnp.asarray(np.asarray(v))
+                 for k, v in p3state["params"].items()}
+        p3step = make_pp3_train_step(p3mesh, optimizer, n_micro=2,
+                                     n_classes=4)
+        p3state, p3m = p3step(p3state, jnp.asarray(xb), jnp.asarray(yb))
+        p3want = float(optax.softmax_cross_entropy_with_integer_labels(
+            pp3_reference_forward(p3ref, jnp.asarray(xb)),
+            jnp.asarray(yb)).mean())
+        np.testing.assert_allclose(float(p3m["loss"]), p3want, rtol=2e-5)

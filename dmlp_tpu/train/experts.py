@@ -174,7 +174,7 @@ def build_moe_state(mesh: Mesh, optimizer, d_in: int, hidden: int, ffn: int,
 
 
 def _moe_a2a_body(params, x, y, *, n_experts: int, n_classes: int,
-                  capacity: int, batch_global: int):
+                  capacity: int):
     ep_idx = jax.lax.axis_index(EP_AXIS)
     n_ep = jax.lax.axis_size(EP_AXIS)
     e_local = params["up"].shape[0]
@@ -235,8 +235,30 @@ def _moe_a2a_body(params, x, y, *, n_experts: int, n_classes: int,
     acc = (jnp.argmax(out, -1) == y).astype(jnp.float32)
     # Per-cell SUM partials; the caller divides by the global batch — the
     # same no-collective-on-the-loss-path rule as the dense dispatch.
-    del batch_global
     return ce.sum()[None], acc.sum()[None]
+
+
+def a2a_capacity(batch: int, dp: int, ep: int,
+                 capacity_factor: float = 1.0) -> int:
+    """Per-(source cell, destination cell) dispatch slots for the a2a step.
+
+    Local tokens per cell = batch / (dp * ep); uniform routing sends
+    local/ep of them to each destination, so capacity =
+    ceil(cf * local / ep). cf >= ep makes capacity >= local tokens —
+    zero drops regardless of routing skew (the grad-exact regime the
+    equivalence tests pin)."""
+    if batch % (dp * ep):
+        raise ValueError(f"a2a dispatch needs batch divisible by dp*ep "
+                         f"({batch} % {dp * ep})")
+    local = batch // (dp * ep)
+    return max(1, int(np.ceil(capacity_factor * local / ep)))
+
+
+def a2a_batch_shardings(mesh: Mesh):
+    """(x, y) sharded over BOTH mesh axes on the batch dim — the a2a
+    step's input layout (dense keeps train.sharding.batch_shardings)."""
+    return (NamedSharding(mesh, P((DP_AXIS, EP_AXIS), None)),
+            NamedSharding(mesh, P((DP_AXIS, EP_AXIS))))
 
 
 def make_moe_a2a_train_step(mesh: Mesh,
@@ -250,8 +272,7 @@ def make_moe_a2a_train_step(mesh: Mesh,
                          "dispatch buffers; to drop everything, don't run "
                          "the experts)")
     body = functools.partial(_moe_a2a_body, n_experts=n_experts,
-                             n_classes=n_classes, capacity=capacity,
-                             batch_global=0)
+                             n_classes=n_classes, capacity=capacity)
     sharded_loss = jax.shard_map(
         body, mesh=mesh,
         in_specs=(MOE_PSPECS, P((DP_AXIS, EP_AXIS), None),

@@ -16,7 +16,8 @@ dp x tp x pp pipelined stack, or the dp x ep MoE. Usage::
     python -m dmlp_tpu.train.loop --parallelism dp_pp3 --mesh 1,2,4 \
         --dims 64,256,10
     python -m dmlp_tpu.train.loop --parallelism dp_ep  --mesh 2,4 \
-        --dims 64,256,512,10 --experts 8
+        --dims 64,256,512,10 --experts 8 \
+        [--moe-dispatch dense|a2a] [--capacity-factor 1.0]
 """
 
 from __future__ import annotations
@@ -89,13 +90,31 @@ def build_sharded_state(mesh, dims, optimizer, seed: int = 0,
 
 def _build_parallel(parallelism: str, mesh_shape, dims, optimizer,
                     compute_dtype, offload, seed: int, n_micro: int,
-                    n_experts: int):
-    """(mesh, state, step_fn, data_dims) for the chosen parallelism
-    family. "dp_tp" is the full-featured default (offload levels, compute
-    dtype); "dp_pp"/"dp_pp3"/"dp_ep" run the pipeline/MoE steps — their
-    mesh comes from --mesh (DP,PP / DP,TP,PP / DP,EP), dims are
-    (in, hidden, classes) for the pipelines (layers spread uniformly over
-    stages, 2 per stage) and (in, hidden, ffn, classes) for the MoE."""
+                    n_experts: int, batch: int = 0,
+                    moe_dispatch: str = "dense",
+                    capacity_factor: float = 1.0):
+    """(mesh, state, step_fn, data_dims, batch_shardings) for the chosen
+    parallelism family. "dp_tp" is the full-featured default (offload
+    levels, compute dtype); "dp_pp"/"dp_pp3"/"dp_ep" run the pipeline/MoE
+    steps — their mesh comes from --mesh (DP,PP / DP,TP,PP / DP,EP), dims
+    are (in, hidden, classes) for the pipelines (layers spread uniformly
+    over stages, 2 per stage) and (in, hidden, ffn, classes) for the MoE.
+    ``moe_dispatch`` picks the MoE form (dp_ep only): "dense" one-hot
+    (capacity-free, masked compute) or "a2a" (capacity + all-to-all
+    production dispatch; ``capacity_factor`` scales the per-(source,
+    destination) slot count around the uniform-routing expectation,
+    train.experts.a2a_capacity)."""
+    # MoE-dispatch flags raise when inapplicable (same no-silent-ignore
+    # rule as --compute-dtype/--offload below): a benchmark invoked with
+    # --moe-dispatch a2a that silently trained the dp_tp MLP would
+    # misattribute its numbers.
+    if moe_dispatch != "dense" and parallelism != "dp_ep":
+        raise ValueError(f"--moe-dispatch applies to dp_ep only, "
+                         f"not {parallelism}")
+    if capacity_factor != 1.0 and not (parallelism == "dp_ep"
+                                       and moe_dispatch == "a2a"):
+        raise ValueError("--capacity-factor applies to the dp_ep a2a "
+                         "dispatch only (dense is capacity-free)")
     if parallelism == "dp_tp":
         mesh = make_train_mesh(mesh_shape)
         offload = resolve_offload_level(offload)
@@ -107,7 +126,8 @@ def _build_parallel(parallelism: str, mesh_shape, dims, optimizer,
             step_fn = make_offload_train_step(optimizer, cdtype, state)
         else:
             step_fn = make_train_step(optimizer, cdtype)
-        return mesh, state, step_fn, (dims[0], dims[-1])
+        return (mesh, state, step_fn, (dims[0], dims[-1]),
+                batch_shardings(mesh))
 
     # The pipeline/MoE families run f32 without host offload; silently
     # ignoring these flags would misattribute benchmark numbers.
@@ -138,7 +158,8 @@ def _build_parallel(parallelism: str, mesh_shape, dims, optimizer,
             step_fn = pl.make_pp3_train_step(mesh, optimizer,
                                              n_micro=n_micro,
                                              n_classes=n_classes)
-        return mesh, state, step_fn, (d_in, n_classes)
+        return mesh, state, step_fn, (d_in, n_classes), \
+            batch_shardings(mesh)
 
     if parallelism == "dp_ep":
         from dmlp_tpu.train import experts as ex
@@ -149,10 +170,19 @@ def _build_parallel(parallelism: str, mesh_shape, dims, optimizer,
         mesh = ex.make_ep_mesh(dp, ep)
         state = ex.build_moe_state(mesh, optimizer, d_in, hidden, ffn,
                                    n_classes, n_experts, seed=seed)
+        if moe_dispatch == "a2a":
+            capacity = ex.a2a_capacity(batch, dp, ep, capacity_factor)
+            step_fn = ex.make_moe_a2a_train_step(mesh, optimizer,
+                                                 n_experts=n_experts,
+                                                 n_classes=n_classes,
+                                                 capacity=capacity)
+            return mesh, state, step_fn, (d_in, n_classes), \
+                ex.a2a_batch_shardings(mesh)
         step_fn = ex.make_moe_train_step(mesh, optimizer,
                                          n_experts=n_experts,
                                          n_classes=n_classes)
-        return mesh, state, step_fn, (d_in, n_classes)
+        return mesh, state, step_fn, (d_in, n_classes), \
+            batch_shardings(mesh)
 
     raise ValueError(f"unknown parallelism {parallelism!r}")
 
@@ -164,18 +194,19 @@ def train(steps: int = 100, batch: int = 1024,
           checkpoint_dir: Optional[str] = None, ckpt_every: int = 100,
           resume: bool = False, metrics: Optional[MetricsLogger] = None,
           log_every: int = 10, offload=False, parallelism: str = "dp_tp",
-          n_micro: int = 4, n_experts: int = 8):
+          n_micro: int = 4, n_experts: int = 8,
+          moe_dispatch: str = "dense", capacity_factor: float = 1.0):
     optimizer = make_optimizer(optimizer_name, lr)
-    mesh, state, step_fn, (d_in, n_classes) = _build_parallel(
+    mesh, state, step_fn, (d_in, n_classes), shardings = _build_parallel(
         parallelism, mesh_shape, tuple(dims), optimizer, compute_dtype,
-        offload, seed, n_micro, n_experts)
+        offload, seed, n_micro, n_experts, batch=batch,
+        moe_dispatch=moe_dispatch, capacity_factor=capacity_factor)
     n_chips = mesh.devices.size
     start_step = 0
     if resume and checkpoint_dir and ckpt_lib.latest_step(checkpoint_dir) is not None:
         state = ckpt_lib.restore_checkpoint(checkpoint_dir, state)
         start_step = int(jax.device_get(state["step"]))
 
-    shardings = batch_shardings(mesh)
     from dmlp_tpu.train.data import prefetch_to_device
     data = prefetch_to_device(
         teacher_batches(d_in, n_classes, batch, seed=seed + 1), shardings)
@@ -225,6 +256,17 @@ def main(argv=None) -> int:
                    help="pipeline microbatches per step (dp_pp/dp_pp3)")
     p.add_argument("--experts", type=int, default=8,
                    help="MoE expert count (dp_ep; divisible by EP)")
+    p.add_argument("--moe-dispatch", default="dense",
+                   choices=["dense", "a2a"],
+                   help="dp_ep dispatch: dense one-hot (capacity-free, "
+                        "masked compute) or capacity + all-to-all (the "
+                        "production EP form; tokens route to the "
+                        "expert-owning cells over ICI, overflow drops to "
+                        "the residual path)")
+    p.add_argument("--capacity-factor", type=float, default=1.0,
+                   help="a2a capacity factor: per-(source, destination) "
+                        "slots = ceil(cf * local_tokens / EP); cf >= EP "
+                        "guarantees zero drops")
     p.add_argument("--optimizer", default="sgd", choices=["sgd", "adam"])
     p.add_argument("--lr", type=float, default=1e-2)
     p.add_argument("--compute-dtype", default=None,
@@ -256,7 +298,9 @@ def main(argv=None) -> int:
         checkpoint_dir=args.checkpoint_dir, ckpt_every=args.ckpt_every,
         resume=args.resume, metrics=metrics, log_every=args.log_every,
         offload=args.offload, parallelism=args.parallelism,
-        n_micro=args.microbatches, n_experts=args.experts)
+        n_micro=args.microbatches, n_experts=args.experts,
+        moe_dispatch=args.moe_dispatch,
+        capacity_factor=args.capacity_factor)
     print(f"final: {last}")
     return 0
 

@@ -1,6 +1,7 @@
 """Sharded/ring engines on the virtual 8-device CPU mesh vs the golden model."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -141,3 +142,37 @@ def test_sharded_chunked_extract_overshoot_shard_boundary():
     shard_rows, nchunks, chunk_rows = plan_chunks(60000, 12800, 25600)
     assert nchunks * chunk_rows > shard_rows
     assert_same_results(got, knn_golden(inp))
+
+
+@needs_devices(8)
+def test_sharded_device_full_stages_swapped_dtype(monkeypatch):
+    """ADVICE r4 (medium): no_auto_coarsen swaps engine._staging to
+    float32 for device-full runs, but the mesh staging sites used to
+    re-resolve dtype="auto" via the config — which returns bfloat16 on
+    TPU — silently staging bf16 under a float32 ordering contract. CPU
+    can't hit the TPU branch of resolve_dtype, so simulate it: force
+    resolve_dtype to "bfloat16" and assert staging follows the ENGINE's
+    swapped state, not the config."""
+    import ml_dtypes
+    from dmlp_tpu.engine.single import no_auto_coarsen
+
+    monkeypatch.setattr(EngineConfig, "resolve_dtype",
+                        lambda self: "bfloat16" if self.dtype == "auto"
+                        else self.dtype)
+    text = generate_input_text(64, 6, 3, -2, 2, 1, 4, 2, seed=7)
+    inp = parse_input_text(text)
+    eng = ShardedEngine(EngineConfig(mode="sharded", dtype="auto"),
+                        mesh=make_mesh((4, 2)))
+    assert eng._staging == "bfloat16"
+    assert eng._np_dtype() == ml_dtypes.bfloat16
+    d_attrs, _, _, q_attrs = eng._shard_inputs(inp, 8)
+    assert d_attrs.dtype == jnp.bfloat16 and q_attrs.dtype == jnp.bfloat16
+    with no_auto_coarsen(eng):
+        assert eng._staging == "float32"
+        assert eng._np_dtype() == np.float32
+        d_attrs, _, _, q_attrs = eng._shard_inputs(inp, 8)
+        assert d_attrs.dtype == jnp.float32, \
+            "device-full staging must follow the swapped engine state"
+        assert q_attrs.dtype == jnp.float32
+    # Swap restored after the context.
+    assert eng._staging == "bfloat16"

@@ -251,6 +251,27 @@ def test_train_loop_parallelism_families(tmp_path):
     assert np.isfinite(last4["loss"])
 
 
+def test_train_loop_moe_a2a_dispatch():
+    """VERDICT r4 item 1: the capacity + all-to-all MoE dispatch is
+    reachable from the production loop (moe_dispatch="a2a"), trains with
+    finite loss, and at cf >= EP (zero drops) its first-step loss equals
+    the dense dispatch's on the identical state/batch."""
+    common = dict(steps=1, batch=32, dims=(8, 16, 24, 3),
+                  mesh_shape=(2, 4), lr=0.05, log_every=1, seed=7,
+                  parallelism="dp_ep", n_experts=4)
+    _, dense = train(moe_dispatch="dense", **common)
+    _, a2a = train(moe_dispatch="a2a", capacity_factor=4.0, **common)
+    assert np.isfinite(a2a["loss"])
+    assert a2a["loss"] == pytest.approx(dense["loss"], rel=2e-5)
+
+    # Tight capacity (cf=1) still trains — drops go to the residual path.
+    _, tight = train(steps=4, batch=32, dims=(8, 16, 24, 3),
+                     mesh_shape=(1, 4), lr=0.05, log_every=4, seed=7,
+                     parallelism="dp_ep", n_experts=4,
+                     moe_dispatch="a2a", capacity_factor=1.0)
+    assert np.isfinite(tight["loss"])
+
+
 def test_train_loop_rejects_inapplicable_flags():
     with pytest.raises(ValueError, match="compute-dtype"):
         train(steps=1, batch=8, dims=(4, 8, 2), mesh_shape=(1, 2),
@@ -261,3 +282,24 @@ def test_train_loop_rejects_inapplicable_flags():
     from dmlp_tpu.train.pipeline import make_axes_mesh
     with pytest.raises(ValueError, match=">= 1"):
         make_axes_mesh({"dp": 1, "pp": 0})
+    with pytest.raises(ValueError, match="moe-dispatch"):
+        train(steps=1, batch=8, dims=(4, 8, 2), mesh_shape=(1, 2),
+              parallelism="dp_pp", moe_dispatch="a2a")
+    from dmlp_tpu.train.experts import a2a_capacity
+    with pytest.raises(ValueError, match="divisible"):
+        a2a_capacity(30, 2, 4)
+
+
+def test_moe_dispatch_flags_raise_on_dp_tp():
+    """--moe-dispatch/--capacity-factor must raise on EVERY non-dp_ep
+    family including the default dp_tp (whose branch returns early)."""
+    with pytest.raises(ValueError, match="moe-dispatch"):
+        train(steps=1, batch=8, dims=(4, 8, 2), mesh_shape=(1, 1),
+              parallelism="dp_tp", moe_dispatch="a2a")
+    with pytest.raises(ValueError, match="capacity-factor"):
+        train(steps=1, batch=8, dims=(4, 8, 2), mesh_shape=(1, 1),
+              parallelism="dp_tp", capacity_factor=2.0)
+    with pytest.raises(ValueError, match="capacity-factor"):
+        train(steps=1, batch=32, dims=(8, 16, 24, 3), mesh_shape=(1, 4),
+              parallelism="dp_ep", n_experts=4, moe_dispatch="dense",
+              capacity_factor=0.25)
