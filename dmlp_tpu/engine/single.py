@@ -257,6 +257,28 @@ def _extract_finalize(od, oi, glabels, *, k):
     return select_topk(od, labels, oi, k)
 
 
+@functools.partial(jax.jit, static_argnames=("kcap",))
+def _mp_merge(dists, ids, glabels, *, kcap):
+    """Merge the multi-pass extraction slabs: (Q, P*kc) concatenated
+    lists -> dedup by id (eps-overlapped floors re-extract boundary
+    candidates on purpose; duplicates carry identical device distances,
+    so id-identity is the whole test) -> gather labels -> composite-sort
+    to the final (Q, kcap) selection order. Also returns the per-row
+    valid-candidate count for the driver's shortfall check."""
+    from dmlp_tpu.ops.topk import select_topk
+    order = jnp.argsort(ids, axis=1)
+    sid = jnp.take_along_axis(ids, order, 1)
+    sd = jnp.take_along_axis(dists, order, 1)
+    dup = jnp.concatenate([jnp.zeros_like(sid[:, :1], bool),
+                           sid[:, 1:] == sid[:, :-1]], axis=1)
+    invalid = dup | (sid < 0)
+    sd = jnp.where(invalid, jnp.inf, sd)
+    sid = jnp.where(invalid, -1, sid)
+    n = glabels.shape[0]
+    lab = jnp.where(sid >= 0, glabels[jnp.clip(sid, 0, max(n - 1, 0))], -1)
+    return select_topk(sd, lab, sid, kcap), jnp.sum(sid >= 0, axis=1)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("k", "data_block", "select", "use_pallas"))
 def _topk_blocks(data_attrs, data_labels, data_ids, q_blocks, *, k,
@@ -294,6 +316,8 @@ class SingleChipEngine:
                        else jnp.float32)
         self.last_phase_ms: dict = {}
         self.last_hetk = None  # (bulk, outlier) counts when routing split
+        self.last_mp_passes = 0  # multi-pass extraction pass count
+        self._mp_hazard = None   # its per-query loss flags (run() repairs)
 
     def _prep(self, inp: KNNInput):
         cfg = self.config
@@ -470,6 +494,147 @@ class SingleChipEngine:
         top = _extract_finalize(od, oi, jnp.asarray(inp.labels), k=k)
         return top, qpad
 
+    # Multi-pass resident-dataset budget: every pass re-sweeps the staged
+    # chunks, so they must stay device-resident (re-uploading P times would
+    # be transfer-bound suicide on the tunneled link). 2 GiB staged attrs
+    # leaves ample HBM for lists + scratch on a 16 GiB chip; bigger
+    # datasets keep the streaming fallback.
+    _MP_RESIDENT_BUDGET = 2 << 30
+    _MP_MAX_PASSES = 16
+    _MP_KC = 512  # slots per pass — the kernel's widest tuned window
+
+    def _solve_extract_multipass(self, inp: KNNInput):
+        """All-wide-k solve on the extraction kernel in P floor-raised
+        passes (VERDICT r4 item 2).
+
+        When EVERY query's k overflows the kernel's kc cap the router
+        (hetk_split) has no bulk to keep and r4 dropped the whole input to
+        the streaming selects — even though k is legal up to num_data
+        (generate_input.py:19). Instead: stage the chunks once
+        (device-resident), and sweep them P = ceil(kcap/512) times. Pass 1
+        runs the plain kernel; pass p+1 masks candidates below that row's
+        previous max MINUS the staging-eps margin (the kernel's new
+        ``floor`` input), so each pass extracts the next ~512-wide slab of
+        the top-k. The eps overlap deliberately re-extracts boundary
+        candidates rather than risk losing a tie — _mp_merge dedups by id
+        and composite-sorts to the final width.
+
+        Correctness: the kernel guarantees every unextracted candidate
+        sits at or above the pass's max, so the union is complete below
+        the last pass's max minus eps. The two loss modes both flag for
+        exact oracle repair (run() ORs _mp_hazard into the standard
+        boundary test): STALL (a >512-wide tie plateau pins the floor; the
+        pass adds nothing and fd stops rising) and SHORTFALL (eps-window
+        duplicates ate enough slots that a row ends with fewer than
+        min(k, n) distinct candidates).
+
+        Returns a run()-compatible segment list, or None when the plan
+        doesn't apply (k fits single-pass, kernel can't tile, dataset too
+        big to keep resident, or P would exceed _MP_MAX_PASSES).
+        """
+        import time as _time
+
+        from dmlp_tpu.ops.pallas_distance import native_pallas_backend
+        from dmlp_tpu.ops.pallas_extract import QUERY_TILE, extract_topk
+        from dmlp_tpu.ops.pallas_extract import supports as extract_supports
+
+        cfg = self.config
+        n = inp.params.num_data
+        na = inp.params.num_attrs
+        nq = inp.params.num_queries
+        if n == 0 or nq == 0 or not cfg.use_pallas:
+            return None
+        if cfg.select not in ("auto", "extract"):
+            return None
+        if cfg.resolve_select(round_up(max(n, 1), 8)) != "extract":
+            return None
+        kc = self._MP_KC
+        kmax = int(inp.ks.max())
+        if resolve_kcap(cfg, kmax, "extract", 1 << 30,
+                        self._staging) <= kc:
+            return None  # single-pass (or the hetk router) owns this k
+        granule = cfg.resolve_granule("extract")
+        npad, nchunks, chunk_rows = plan_chunks(n, granule, cfg.data_block)
+        kcap = resolve_kcap(cfg, kmax, "extract", npad,
+                            staging=self._staging)
+        npasses = -(-kcap // kc)
+        if npasses > self._MP_MAX_PASSES:
+            return None
+        itemsize = 2 if self._staging == "bfloat16" else 4
+        if npad * na * itemsize > self._MP_RESIDENT_BUDGET:
+            return None
+        qpad = round_up(nq, QUERY_TILE)
+        if not extract_supports(qpad, chunk_rows, na, kc):
+            return None
+        interpret = not native_pallas_backend()
+        self._last_select = "extract"
+
+        t0 = _time.perf_counter()
+        q_attrs = np.zeros((qpad, na), np.float32)
+        q_attrs[:nq] = inp.query_attrs
+        q_dev = jnp.asarray(q_attrs, self._dtype)
+        src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
+
+        # Pass 1 overlaps with staging, like the single-pass driver; the
+        # chunks stay resident for passes 2..P.
+        chunks: List[Tuple] = []
+        od = oi = None
+        throttle = ChunkThrottle()
+        for c in range(nchunks):
+            lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
+            if lo >= n:
+                break
+            a = np.zeros((chunk_rows, na), np.float32)
+            a[:hi - lo] = src_attrs[lo:hi]
+            da = jnp.asarray(a, self._dtype)
+            chunks.append((da, lo, hi))
+            od, oi, _ = extract_topk(q_dev, da, od, oi, n_real=hi - lo,
+                                     id_base=lo, kc=kc, interpret=interpret)
+            throttle.tick(od)
+        ods, ois = [od], [oi]
+
+        # Host-side floor/hazard bookkeeping (f64, like run()'s eps path).
+        qn = np.zeros(qpad, np.float64)
+        qn[:nq] = np.einsum("qa,qa->q", inp.query_attrs, inp.query_attrs)
+        dn_max = float(np.einsum("na,na->n", inp.data_attrs,
+                                 inp.data_attrs).max())
+        stalled = np.zeros(qpad, bool)
+        exhausted = np.zeros(qpad, bool)
+        fd_prev = None
+        for _p in range(1, npasses):
+            last_od = ods[-1]
+            fd = np.asarray(jax.device_get(jnp.max(last_od, axis=1)),
+                            np.float64)
+            exhausted |= ~np.isfinite(fd)
+            if fd_prev is not None:
+                stalled |= np.isfinite(fd) & (fd <= fd_prev)
+            fd_prev = fd
+            if np.all(exhausted | stalled):
+                break  # nothing left to find / floors pinned by plateaus
+            eps = staging_eps(np.where(np.isfinite(fd), fd, 0.0), qn,
+                              dn_max, self._staging, na)
+            floor = np.where(np.isfinite(fd), fd - eps, np.inf)
+            floor_dev = jnp.asarray(floor[:, None], jnp.float32)
+            od = oi = None
+            for da, lo, hi in chunks:
+                od, oi, _ = extract_topk(q_dev, da, od, oi, n_real=hi - lo,
+                                         id_base=lo, kc=kc,
+                                         interpret=interpret,
+                                         floor=floor_dev)
+                throttle.tick(od)
+            ods.append(od)
+            ois.append(oi)
+        self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
+        self.last_mp_passes = len(ods)
+
+        top, valid = _mp_merge(jnp.concatenate(ods, axis=1),
+                               jnp.concatenate(ois, axis=1),
+                               jnp.asarray(inp.labels), kcap=kcap)
+        needed = np.minimum(inp.ks.astype(np.int64), n)
+        shortfall = np.asarray(jax.device_get(valid))[:nq] < needed
+        self._mp_hazard = stalled[:nq] | shortfall
+        return [(top, qpad, None, "extract")]
+
     def _solve(self, inp: KNNInput) -> Tuple[TopK, int]:
         self.last_phase_ms = {}  # no stale phases if a path is skipped
         select = self.config.resolve_select(
@@ -563,17 +728,29 @@ class SingleChipEngine:
         return [(top_b, qpad_b, bulk, "extract"),
                 (carry_o, qo_pad, outl, select_out)]
 
-    def _solve_segments(self, inp: KNNInput):
+    def _solve_segments(self, inp: KNNInput, allow_multipass: bool = True):
         """Solve as a list of (TopK, qpad, query_idx | None, select)
         segments — one segment for homogeneous k, two when the
         heterogeneous-k router splits huge-k outliers off the extraction
         kernel's bulk. Queries in different segments are independent
-        sub-problems; run()/run_device_full merge by original index."""
+        sub-problems; run()/run_device_full merge by original index.
+
+        ``allow_multipass`` gates the all-wide-k multi-pass extraction:
+        its loss modes (tie plateau / eps-window shortfall) are only made
+        exact by run()'s host repair, so run_device_full — which has no
+        repair — keeps the streaming fallback instead."""
         self.last_hetk = None
+        self._mp_hazard = None
+        self.last_mp_passes = 0
         plan = self._plan_hetk(inp)
         if plan is not None:
             self.last_phase_ms = {}
             segs = self._solve_extract_routed(inp, plan)
+            if segs is not None:
+                return segs
+        if allow_multipass:
+            self.last_phase_ms = {}
+            segs = self._solve_extract_multipass(inp)
             if segs is not None:
                 return segs
         top, qpad = self._solve(inp)
@@ -647,6 +824,11 @@ class SingleChipEngine:
                 eps = staging_eps(last, qn, dn_max, self._staging,
                                   inp.params.num_attrs)
                 flags = boundary_hazard(kth, last, eps)
+            # Multi-pass extraction's own loss detectors (stall/shortfall,
+            # _solve_extract_multipass) join the standard boundary test.
+            mp = getattr(self, "_mp_hazard", None)
+            if mp is not None and idx is None:
+                flags = mp if flags is None else (flags | mp)
             labels = np.where(ids >= 0,
                               inp.labels[np.clip(ids, 0, max(n - 1, 0))], -1) \
                 if n else np.full_like(ids, -1)
@@ -682,7 +864,7 @@ class SingleChipEngine:
         num_labels = int(inp.labels.max()) + 1 if inp.params.num_data else 1
         merged: List[QueryResult] = [None] * inp.params.num_queries
         with no_auto_coarsen(self):
-            segments = self._solve_segments(inp)
+            segments = self._solve_segments(inp, allow_multipass=False)
         for top, qpad, idx, _select in segments:
             sub = inp if idx is None else subset_queries(inp, idx)
             nq = sub.params.num_queries

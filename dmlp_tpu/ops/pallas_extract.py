@@ -108,8 +108,8 @@ def supports(qb: int, b: int, a: int, kc: int) -> bool:
     return vmem <= 64 * 2**20
 
 
-def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, cd_ref, ci_ref, od_ref,
-            oi_ref, it_ref, dist_s, *, kc: int, fresh: bool, ne: int,
+def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, f_ref, cd_ref, ci_ref,
+            od_ref, oi_ref, it_ref, dist_s, *, kc: int, fresh: bool, ne: int,
             unroll: int = 1):
     j = pl.program_id(1)
     nj = pl.num_programs(1)
@@ -128,6 +128,11 @@ def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, cd_ref, ci_ref, od_ref,
         preferred_element_type=jnp.float32)
     dist = qn_ref[:] + dn_ref[:] - 2.0 * cross
     dist = jnp.maximum(dist, 0.0)
+    # Per-row floor (multi-pass extraction, engine.single
+    # ._solve_extract_multipass): candidates strictly below the floor were
+    # captured by an earlier pass — mask them so this pass extracts the
+    # NEXT kc-wide slab. Single-pass callers pass -inf (no-op).
+    dist = jnp.where(dist < f_ref[:], jnp.inf, dist)
     lane = jax.lax.broadcasted_iota(jnp.int32, (tq, tn), 1)
     pos = j * tn + lane
     dist = jnp.where(pos >= n_real, jnp.inf, dist)
@@ -216,12 +221,16 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
                  carry_i: jax.Array | None = None, *, n_real,
                  id_base=0, kc: int, interpret: bool = False,
                  tile_q: int | None = None, tile_n: int = _TN,
-                 ne: int | None = None, unroll: int | None = None):
+                 ne: int | None = None, unroll: int | None = None,
+                 floor: jax.Array | None = None):
     """(queries (Qb, A), data (B, A)) -> (dists (Qb, kc) f32 ascending-ish
     unsorted, ids (Qb, kc) i32, iters (Qb/tq, B/tn) i32 loop counts).
     Rows >= n_real are sentinels; data row j has global id id_base + j.
     Optional carry (prior running lists, e.g. from a previous chunk) is
-    folded in; without it slots pad (+inf, -1).
+    folded in; without it slots pad (+inf, -1). Optional ``floor``
+    ((Qb, 1) f32): per-row distance floor — candidates with
+    dist < floor are masked out (the multi-pass wide-k driver raises it
+    to the previous pass's max − eps each pass).
 
     tile_q/ne/unroll default to the kc-tuned variant (tuned_variant);
     pass them explicitly only to override (the sweep tool does).
@@ -257,6 +266,8 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
     if fresh:
         carry_d = jnp.full((qb, kc), jnp.inf, jnp.float32)
         carry_i = jnp.full((qb, kc), -1, jnp.int32)
+    if floor is None:
+        floor = jnp.full((qb, 1), -jnp.inf, jnp.float32)
 
     scalars = jnp.asarray([[n_real, id_base]], jnp.int32)     # (1, 2) SMEM
     grid = (qb // tq, b // tn)
@@ -272,6 +283,7 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
             pl.BlockSpec((tn, a), lambda i, j: (j, 0)),
             pl.BlockSpec((tq, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((1, tn), lambda i, j: (0, j)),
+            pl.BlockSpec((tq, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((tq, kc), lambda i, j: (i, 0)),
             pl.BlockSpec((tq, kc), lambda i, j: (i, 0)),
         ],
@@ -293,5 +305,5 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
             dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=96 * 2**20),
         interpret=interpret,
-    )(scalars, q32, d32, qn, dn, carry_d, carry_i)
+    )(scalars, q32, d32, qn, dn, floor, carry_d, carry_i)
     return out_d, out_i, out_iters[::tq]

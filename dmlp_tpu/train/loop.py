@@ -92,7 +92,8 @@ def _build_parallel(parallelism: str, mesh_shape, dims, optimizer,
                     compute_dtype, offload, seed: int, n_micro: int,
                     n_experts: int, batch: int = 0,
                     moe_dispatch: str = "dense",
-                    capacity_factor: float = 1.0):
+                    capacity_factor: float = 1.0,
+                    pp_schedule: str = "gpipe", n_virtual: int = 2):
     """(mesh, state, step_fn, data_dims, batch_shardings) for the chosen
     parallelism family. "dp_tp" is the full-featured default (offload
     levels, compute dtype); "dp_pp"/"dp_pp3"/"dp_ep" run the pipeline/MoE
@@ -103,7 +104,9 @@ def _build_parallel(parallelism: str, mesh_shape, dims, optimizer,
     (capacity-free, masked compute) or "a2a" (capacity + all-to-all
     production dispatch; ``capacity_factor`` scales the per-(source,
     destination) slot count around the uniform-routing expectation,
-    train.experts.a2a_capacity)."""
+    train.experts.a2a_capacity). ``pp_schedule`` picks the dp_pp
+    schedule: "gpipe" or "interleaved" (V = ``n_virtual`` chunks per
+    stage; bubble / V at n_micro <= stages, pipeline.bubble_fraction)."""
     # MoE-dispatch flags raise when inapplicable (same no-silent-ignore
     # rule as --compute-dtype/--offload below): a benchmark invoked with
     # --moe-dispatch a2a that silently trained the dp_tp MLP would
@@ -115,6 +118,12 @@ def _build_parallel(parallelism: str, mesh_shape, dims, optimizer,
                                        and moe_dispatch == "a2a"):
         raise ValueError("--capacity-factor applies to the dp_ep a2a "
                          "dispatch only (dense is capacity-free)")
+    if pp_schedule != "gpipe" and parallelism != "dp_pp":
+        raise ValueError(f"--pp-schedule applies to dp_pp only, "
+                         f"not {parallelism}")
+    if n_virtual != 2 and pp_schedule != "interleaved":
+        raise ValueError("--virtual-stages applies to the interleaved "
+                         "dp_pp schedule only")
     if parallelism == "dp_tp":
         mesh = make_train_mesh(mesh_shape)
         offload = resolve_offload_level(offload)
@@ -146,6 +155,28 @@ def _build_parallel(parallelism: str, mesh_shape, dims, optimizer,
         if parallelism == "dp_pp":
             dp, pp = mesh_shape or (1, len(jax.devices()))
             mesh = pl.make_pp_mesh(dp, pp)
+            if pp_schedule == "interleaved":
+                # Same model as the gpipe branch (2 layers per stage, the
+                # documented dp_pp architecture): V chunks of 2/V layers.
+                # A V that doesn't divide it would silently change the
+                # depth and make schedule A/Bs compare different models.
+                lps = 2
+                if lps % n_virtual:
+                    raise ValueError(
+                        f"--virtual-stages must divide the dp_pp model's "
+                        f"{lps} layers per stage (got {n_virtual}); deeper "
+                        "chunking is a library-API choice "
+                        "(pipeline.build_ppi_state)")
+                state = pl.build_ppi_state(mesh, optimizer, d_in, hidden,
+                                           n_classes, n_virtual=n_virtual,
+                                           layers_per_chunk=lps // n_virtual,
+                                           seed=seed)
+                step_fn = pl.make_ppi_train_step(mesh, optimizer,
+                                                 n_micro=n_micro,
+                                                 n_virtual=n_virtual,
+                                                 n_classes=n_classes)
+                return mesh, state, step_fn, (d_in, n_classes), \
+                    batch_shardings(mesh)
             state = pl.build_pp_state(mesh, optimizer, d_in, hidden,
                                       n_classes, 2, seed=seed)
             step_fn = pl.make_pp_train_step(mesh, optimizer, n_micro=n_micro,
@@ -195,12 +226,14 @@ def train(steps: int = 100, batch: int = 1024,
           resume: bool = False, metrics: Optional[MetricsLogger] = None,
           log_every: int = 10, offload=False, parallelism: str = "dp_tp",
           n_micro: int = 4, n_experts: int = 8,
-          moe_dispatch: str = "dense", capacity_factor: float = 1.0):
+          moe_dispatch: str = "dense", capacity_factor: float = 1.0,
+          pp_schedule: str = "gpipe", n_virtual: int = 2):
     optimizer = make_optimizer(optimizer_name, lr)
     mesh, state, step_fn, (d_in, n_classes), shardings = _build_parallel(
         parallelism, mesh_shape, tuple(dims), optimizer, compute_dtype,
         offload, seed, n_micro, n_experts, batch=batch,
-        moe_dispatch=moe_dispatch, capacity_factor=capacity_factor)
+        moe_dispatch=moe_dispatch, capacity_factor=capacity_factor,
+        pp_schedule=pp_schedule, n_virtual=n_virtual)
     n_chips = mesh.devices.size
     start_step = 0
     if resume and checkpoint_dir and ckpt_lib.latest_step(checkpoint_dir) is not None:
@@ -254,6 +287,13 @@ def main(argv=None) -> int:
                         "pipelined stack, dp x ep MoE")
     p.add_argument("--microbatches", type=int, default=4,
                    help="pipeline microbatches per step (dp_pp/dp_pp3)")
+    p.add_argument("--pp-schedule", default="gpipe",
+                   choices=["gpipe", "interleaved"],
+                   help="dp_pp schedule: gpipe, or interleaved virtual "
+                        "stages (1F1B-interleaved; bubble / V, needs "
+                        "microbatches <= PP)")
+    p.add_argument("--virtual-stages", type=int, default=2,
+                   help="interleaved schedule: stage chunks per pp cell")
     p.add_argument("--experts", type=int, default=8,
                    help="MoE expert count (dp_ep; divisible by EP)")
     p.add_argument("--moe-dispatch", default="dense",
@@ -300,7 +340,8 @@ def main(argv=None) -> int:
         offload=args.offload, parallelism=args.parallelism,
         n_micro=args.microbatches, n_experts=args.experts,
         moe_dispatch=args.moe_dispatch,
-        capacity_factor=args.capacity_factor)
+        capacity_factor=args.capacity_factor,
+        pp_schedule=args.pp_schedule, n_virtual=args.virtual_stages)
     print(f"final: {last}")
     return 0
 

@@ -252,6 +252,189 @@ def flat_forward(flat, x):
 
 
 # ---------------------------------------------------------------------------
+# Interleaved schedule (1F1B-interleaved / Megatron virtual stages): each pp
+# cell holds V non-contiguous stage CHUNKS (cell s owns chunks s, s+S, ...,
+# s+(V-1)S of the V*S-chunk layer sequence); the scan runs chunk c of
+# microbatch m at tick m + c, activations ride a uniform +1 ring ppermute
+# (the S-1 -> 0 wraparound carries the level-up hop). Each tick costs a
+# 1/V stage slice, so the pipeline fill/drain shrinks: forward span
+# (M - 1 + V*S) * F/V = ((M-1)/V + S) * F vs GPipe's (M - 1 + S) * F —
+# the bubble term drops by V, which is the whole point at small M
+# (VERDICT r4 item 6). Backward is still jax.grad through the scan (the
+# reverse schedule inherits the same 1/V tick cost).
+#
+# Why not plain (non-interleaved) 1F1B: in a single-jit SPMD program the
+# backward schedule is XLA's reverse of the forward scan, and
+# non-interleaved 1F1B has exactly GPipe's bubble ((S-1)/(M+S-1)) — its
+# advantage is peak activation memory (O(S) in-flight microbatches instead
+# of O(M)), which in this design is the remat lever (jax.checkpoint on the
+# tick body), not a schedule change. Interleaving is the schedule lever
+# that actually moves the bubble, so that is what ships.
+#
+# The masked schedule needs at most one active chunk per cell per tick,
+# which holds when n_micro <= n_stages — exactly the small-M regime where
+# GPipe's bubble hurts; larger M should use GPipe (its bubble term is
+# already amortized there).
+# ---------------------------------------------------------------------------
+
+
+def init_pipeline_interleaved(key, d_in: int, hidden: int, n_classes: int,
+                              stages: int, n_virtual: int,
+                              layers_per_chunk: int,
+                              dtype=jnp.float32) -> PipeParams:
+    """V*S chunk layer stack: pp_w (V, S, P, H, H); chunk (l, s) holds
+    layers [(l*S + s) * P, ...) of the flat sequence, so axis order
+    (level, stage) IS the model's layer order under reshape."""
+    ks = jax.random.split(key, 4)
+    v, s, p, h = n_virtual, stages, layers_per_chunk, hidden
+    scale = jnp.sqrt(2.0 / h).astype(dtype)
+    return {
+        "in_w": jax.random.normal(ks[0], (d_in, h), dtype)
+        * jnp.sqrt(2.0 / d_in).astype(dtype),
+        "in_b": jnp.zeros((h,), dtype),
+        "pp_w": jax.random.normal(ks[1], (v, s, p, h, h), dtype) * scale,
+        "pp_b": jnp.zeros((v, s, p, h), dtype),
+        "out_w": jax.random.normal(ks[2], (h, n_classes), dtype)
+        * jnp.sqrt(2.0 / h).astype(dtype),
+        "out_b": jnp.zeros((n_classes,), dtype),
+    }
+
+
+PPI_PSPECS = {
+    "in_w": P(None, None), "in_b": P(None),
+    "pp_w": P(None, PP_AXIS, None, None, None),
+    "pp_b": P(None, PP_AXIS, None, None),
+    "out_w": P(None, None), "out_b": P(None),
+}
+
+
+def pipeline_interleaved_param_shardings(mesh: Mesh):
+    return {k: NamedSharding(mesh, spec) for k, spec in PPI_PSPECS.items()}
+
+
+def _ppi_body(params, x, y, *, n_stages: int, n_micro: int, n_virtual: int,
+              n_classes: int):
+    """Per-(dp, pp)-cell interleaved pipelined loss partial.
+
+    At tick t, this cell's active chunk is the (l, s_idx) with
+    r = t - s_idx, l = r // S, m = r % S (unique because M <= S); chunk
+    level is a traced dynamic index into the cell's (V, P, H, H) slice.
+    Bubbles compute on zeros and are masked at collection, like _pp_body.
+    """
+    assert params["out_w"].shape[1] == n_classes
+    s_idx = jax.lax.axis_index(PP_AXIS)
+    w_v = params["pp_w"][:, 0]          # (V, P, H, H) — this cell's chunks
+    b_v = params["pp_b"][:, 0]
+
+    h0 = x.astype(jnp.float32) @ params["in_w"] + params["in_b"]
+    mb = h0.shape[0] // n_micro
+    h_mb = h0.reshape(n_micro, mb, -1)
+    ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        act, ys = carry
+        r = t - s_idx
+        lvl = jnp.where(r >= 0, r // n_stages, 0)
+        m = jnp.where(r >= 0, r % n_stages, 0)
+        active = (r >= 0) & (lvl < n_virtual) & (m < n_micro)
+        w_l = jax.lax.dynamic_index_in_dim(
+            w_v, jnp.clip(lvl, 0, n_virtual - 1), 0, keepdims=False)
+        b_l = jax.lax.dynamic_index_in_dim(
+            b_v, jnp.clip(lvl, 0, n_virtual - 1), 0, keepdims=False)
+        fresh = h_mb[jnp.clip(m, 0, n_micro - 1)]
+        inp = jnp.where((s_idx == 0) & (lvl == 0), fresh, act)
+        out = _stage_block(w_l, b_l, inp)
+        take = active & (s_idx == n_stages - 1) & (lvl == n_virtual - 1)
+        ys = jnp.where(
+            take,
+            jax.lax.dynamic_update_index_in_dim(
+                ys, out, jnp.clip(m, 0, n_micro - 1), 0),
+            ys)
+        act = jax.lax.ppermute(out, PP_AXIS, ring) if n_stages > 1 else out
+        return (act, ys), None
+
+    n_ticks = n_micro - 1 + n_virtual * n_stages
+    (_, ys), _ = jax.lax.scan(
+        tick, (jnp.zeros_like(h_mb[0]), jnp.zeros_like(h_mb)),
+        jnp.arange(n_ticks))
+
+    logits = ys.reshape(h0.shape) @ params["out_w"] + params["out_b"]
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+    last = (s_idx == n_stages - 1).astype(loss.dtype)
+    return (loss * last)[None], (acc * last)[None]
+
+
+def make_ppi_train_step(mesh: Mesh, optimizer: optax.GradientTransformation,
+                        *, n_micro: int, n_virtual: int, n_classes: int):
+    """Jitted interleaved-schedule train step over ("dp", "pp"). Requires
+    n_micro <= n_stages (one active chunk per cell per tick)."""
+    n_dp, n_stages = mesh.devices.shape
+    if n_micro > n_stages:
+        raise ValueError(
+            f"interleaved schedule needs n_micro <= n_stages "
+            f"({n_micro} > {n_stages}); use the gpipe schedule there")
+    body = functools.partial(_ppi_body, n_stages=n_stages, n_micro=n_micro,
+                             n_virtual=n_virtual, n_classes=n_classes)
+    sharded_loss = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(PPI_PSPECS, P(DP_AXIS, None), P(DP_AXIS)),
+        out_specs=(P((DP_AXIS, PP_AXIS)), P((DP_AXIS, PP_AXIS))),
+        check_vma=False)
+    return _partials_train_step(sharded_loss, optimizer, n_dp)
+
+
+def build_ppi_state(mesh: Mesh, optimizer, d_in: int, hidden: int,
+                    n_classes: int, n_virtual: int, layers_per_chunk: int,
+                    seed: int = 0):
+    stages = mesh.devices.shape[1]
+    params = init_pipeline_interleaved(
+        jax.random.PRNGKey(seed), d_in, hidden, n_classes, stages,
+        n_virtual, layers_per_chunk)
+    return place_state(params, pipeline_interleaved_param_shardings(mesh),
+                       optimizer)
+
+
+def flatten_interleaved(params: PipeParams) -> Tuple:
+    """Flat single-device stack for the interleaved layout (chunk order
+    (level, stage) = the model's layer order)."""
+    v, s, p, h, _ = params["pp_w"].shape
+    ws = np.asarray(params["pp_w"]).reshape(v * s * p, h, h)
+    bs = np.asarray(params["pp_b"]).reshape(v * s * p, h)
+    return (np.asarray(params["in_w"]), np.asarray(params["in_b"]),
+            ws, bs, np.asarray(params["out_w"]), np.asarray(params["out_b"]))
+
+
+def schedule_ticks(schedule: str, n_micro: int, n_stages: int,
+                   n_virtual: int = 1) -> int:
+    """Scan tick count of each schedule — the bubble arithmetic for the
+    PIPEBENCH record: each tick costs ~one stage-chunk of compute (a full
+    stage for gpipe, a 1/V slice for interleaved)."""
+    if schedule == "gpipe":
+        return n_micro + n_stages - 1
+    if schedule == "interleaved":
+        return n_micro - 1 + n_virtual * n_stages
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def bubble_fraction(schedule: str, n_micro: int, n_stages: int,
+                    n_virtual: int = 1) -> float:
+    """Idle fraction of one device's pipeline span, in stage-work units
+    (a unit = one full stage pass over one microbatch; fwd and bwd scale
+    identically). Per device the useful work is always M units (its V
+    chunks sum to one stage's layers); the span is the tick count times
+    the per-tick cost:
+
+    - gpipe:       (M + S - 1) ticks x 1 unit      -> span M + S - 1
+    - interleaved: (M - 1 + V*S) ticks x 1/V unit  -> span (M-1)/V + S
+
+    so interleaving divides the (S - 1)-shaped fill/drain term by V."""
+    span = (schedule_ticks(schedule, n_micro, n_stages, n_virtual)
+            / (n_virtual if schedule == "interleaved" else 1))
+    return 1.0 - n_micro / span
+
+
+# ---------------------------------------------------------------------------
 # 3D composition: dp x tp x pp in one jit. Stage layers come in Megatron
 # col/row pairs — the column-split matmul shards its OUTPUT dim over "tp",
 # the row-split one its INPUT dim, so each pair needs exactly one tp psum —

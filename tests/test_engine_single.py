@@ -270,3 +270,64 @@ def test_clustered_cancellation_sharded_matches_golden():
     got = eng.run(inp)
     assert eng.last_repairs > 0  # the merged-list hazard must fire here
     assert_same_results(got, knn_golden(inp), check_dists=False)
+
+
+class TestMultipassExtract:
+    """VERDICT r4 item 2: all-wide-k inputs run the extraction kernel in
+    floor-raised passes instead of dropping to the streaming selects."""
+
+    def _run(self, inp):
+        eng = SingleChipEngine(EngineConfig(select="extract",
+                                            use_pallas=True))
+        got = eng.run(inp)
+        assert eng._last_select == "extract"
+        assert eng.last_mp_passes >= 2
+        assert_same_results(got, knn_golden(inp))
+        return eng
+
+    def test_all_wide_k_matches_golden(self):
+        text = generate_input_text(3000, 8, 6, -5, 5, 1300, 1500, 4,
+                                   seed=11)
+        eng = self._run(parse_input_text(text))
+        assert eng.last_repairs == 0  # typical data: no plateau/shortfall
+
+    def test_k_equals_num_data_all_queries(self):
+        # k legal up to num_data (generate_input.py:19) — the maximal case.
+        text = generate_input_text(1600, 6, 5, -3, 3, 1600, 1600, 3, seed=5)
+        self._run(parse_input_text(text))
+
+    def test_tie_plateau_stall_repairs_exact(self):
+        # Every point identical: a >512-wide tie plateau pins the floor
+        # after pass 1; the stall detector must flag every query for exact
+        # oracle repair (the no-progress loss mode).
+        n, q, a, k = 2000, 4, 3, 1000
+        lines = [f"{n} {q} {a}"]
+        lines += [f"{i % 3} " + " ".join(["1.000000"] * a)
+                  for i in range(n)]
+        lines += [f"Q {k} " + " ".join(["2.000000"] * a) for _ in range(q)]
+        inp = parse_input_text("\n".join(lines) + "\n")
+        eng = self._run(inp)
+        assert eng.last_repairs == q  # all stalled -> all repaired
+
+    def test_device_full_keeps_streaming_fallback(self):
+        # run_device_full has no host repair, so the multipass path (whose
+        # loss modes rely on it) must not serve it.
+        text = generate_input_text(2000, 8, 4, -2, 2, 900, 1000, 3, seed=3)
+        inp = parse_input_text(text)
+        eng = SingleChipEngine(EngineConfig(select="auto", use_pallas=True))
+        got = eng.run_device_full(inp)
+        assert eng._last_select != "extract"
+        assert_same_results(got, knn_golden(inp), check_dists=False)
+
+    def test_mixed_k_still_routes_hetk(self):
+        # One narrow-k query keeps the router's bulk non-empty: the split
+        # path (not multipass) must own mixed inputs.
+        text = generate_input_text(2000, 8, 4, -2, 2, 4, 8, 3, seed=9)
+        inp = parse_input_text(text)
+        inp.ks[0] = 1800  # one wide outlier
+        eng = SingleChipEngine(EngineConfig(select="extract",
+                                            use_pallas=True))
+        got = eng.run(inp)
+        assert eng.last_hetk is not None
+        assert getattr(eng, "_mp_hazard", None) is None
+        assert_same_results(got, knn_golden(inp))

@@ -134,10 +134,13 @@ def test_engine_extract_duplicate_ties_fast_mode():
 
 
 def test_engine_extract_unsupported_shape_falls_back():
-    # kcap = round_up(600 + 16, 8) = 616 > the kernel's 512 candidate cap,
-    # so _solve_extract must return None and the chunk-fold driver must
-    # take over on the remapped select — results still golden.
-    text = generate_input_text(900, 6, 3, 0, 1, 600, 600, 3, seed=5)
+    # An attr width the kernel can't tile (the VMEM bound in supports():
+    # double-buffered q/d blocks at na=2000 blow the 64 MB budget), so
+    # _solve_extract — and the multi-pass driver, which shares the gate —
+    # must decline and the chunk-fold driver takes over; still golden.
+    # (k beyond the 512 cap no longer falls back: that case now runs the
+    # multi-pass extraction, test_engine_single.TestMultipassExtract.)
+    text = generate_input_text(900, 6, 2000, 0, 1, 8, 16, 3, seed=5)
     inp = parse_input_text(text)
     eng = _engine()
     got = eng.run(inp)

@@ -1,0 +1,123 @@
+#!/usr/bin/env bash
+# capture_oracle.sh — record the reference oracle binaries' ground truth.
+#
+# The four stripped engines (/root/reference/benchmarks/bench_1..4, invoked
+# at run_bench.sh:82-84) are the reference's ground truth, but they need a
+# working Open MPI runtime (orted) and an x86-64 host — absent from the TPU
+# container (SURVEY.md §2 #9, README "Ground-truth scope"). Run THIS script
+# on any x86+OpenMPI host with the reference checkout; it:
+#
+#   1. regenerates the repo's seeded benchmark inputs 1-3 with the
+#      reference's own generate_input.py (identical grammar + RNG draws;
+#      shapes = dmlp_tpu/bench/configs.py BENCH_CONFIGS, seed 42),
+#   2. runs bench_1 < input1, bench_2 < input2, bench_3 < input2,
+#      bench_4 < input3 under `mpirun --timeout 300 --bind-to hwthread`
+#      exactly like run_bench.sh (task counts configurable: the original
+#      used 24/32/80/24 ranks over 2 SLURM nodes),
+#   3. saves stdout (the per-query checksums), stderr (the `Time taken:`
+#      line) and an ORACLE_GOLDEN.json manifest.
+#
+# Back in the repo, `python tools/oracle_diff.py ORACLE_GOLDEN.json` diffs
+# the captured checksums against this framework's engines on the very same
+# inputs — upgrading "parity vs our golden model" to "parity vs the
+# reference binaries".
+#
+# Usage:
+#   ./capture_oracle.sh [-r REF_DIR] [-o OUT_DIR] [-n "NP1 NP2 NP3 NP4"]
+#     REF_DIR: reference checkout (default /root/reference)
+#     OUT_DIR: capture directory (default ./oracle_capture)
+#     NPi:     mpirun task count per config (default: nproc, capped at 24)
+set -euo pipefail
+
+REF_DIR=/root/reference
+OUT_DIR=./oracle_capture
+NPROCS=""
+while getopts "r:o:n:" opt; do
+  case $opt in
+    r) REF_DIR=$OPTARG ;;
+    o) OUT_DIR=$OPTARG ;;
+    n) NPROCS=$OPTARG ;;
+    *) echo "usage: $0 [-r REF_DIR] [-o OUT_DIR] [-n \"NP1 NP2 NP3 NP4\"]" >&2
+       exit 2 ;;
+  esac
+done
+
+command -v mpirun >/dev/null || { echo "FATAL: mpirun not found" >&2; exit 1; }
+command -v python3 >/dev/null || { echo "FATAL: python3 not found" >&2; exit 1; }
+[ -x "$REF_DIR/benchmarks/bench_1" ] || {
+  echo "FATAL: $REF_DIR/benchmarks/bench_1 missing/not executable" >&2; exit 1; }
+
+DEFAULT_NP=$(( $(nproc) < 24 ? $(nproc) : 24 ))
+read -r NP1 NP2 NP3 NP4 <<< "${NPROCS:-$DEFAULT_NP $DEFAULT_NP $DEFAULT_NP $DEFAULT_NP}"
+
+mkdir -p "$OUT_DIR"
+
+# --- 1. regenerate the seeded inputs (shapes = bench/configs.py) ---------
+gen() { # name num_data num_queries num_attrs min max minK maxK labels
+  local name=$1
+  if [ ! -f "$OUT_DIR/$name" ]; then
+    echo ">> generating $name ($2 x $3 x $4)"
+    python3 "$REF_DIR/generate_input.py" \
+      --num_data "$2" --num_queries "$3" --num_attrs "$4" \
+      --min "$5" --max "$6" --minK "$7" --maxK "$8" --num_labels "$9" \
+      --seed 42 --output "$OUT_DIR/$name"
+  fi
+}
+gen input1.in 20000  1000  32 0.0 100.0 1 16 10
+gen input2.in 100000 5000  64 0.0 100.0 1 32 10
+gen input3.in 200000 10000 64 0.0 100.0 1 32 10
+
+# --- 2. run each oracle binary exactly as run_bench.sh does --------------
+run_cfg() { # cfg bench input np
+  local cfg=$1 bench=$2 input=$3 np=$4
+  local out="$OUT_DIR/oracle_${cfg}.out" err="$OUT_DIR/oracle_${cfg}.err"
+  if [ -s "$out" ]; then
+    echo ">> config $cfg cached ($out)"; return
+  fi
+  echo ">> config $cfg: mpirun -np $np $bench < $input"
+  # Write to temp files and mv only on mpirun success: a timed-out or
+  # killed run must not leave a truncated .out that a rerun would treat
+  # as a valid cache (and ship as ground truth).
+  mpirun -np "$np" --timeout 300 --bind-to hwthread \
+    "$REF_DIR/benchmarks/$bench" < "$OUT_DIR/$input" \
+    > "$out.tmp" 2> "$err.tmp"
+  mv "$out.tmp" "$out"
+  mv "$err.tmp" "$err"
+}
+run_cfg 1 bench_1 input1.in "$NP1"
+run_cfg 2 bench_2 input2.in "$NP2"
+run_cfg 3 bench_3 input2.in "$NP3"
+run_cfg 4 bench_4 input3.in "$NP4"
+
+# --- 3. manifest ---------------------------------------------------------
+python3 - "$OUT_DIR" "$NP1" "$NP2" "$NP3" "$NP4" <<'PY'
+import hashlib, json, os, platform, re, subprocess, sys
+out_dir, *nps = sys.argv[1:]
+sha = lambda p: hashlib.sha256(open(p, "rb").read()).hexdigest()
+cfgs = {1: "input1.in", 2: "input2.in", 3: "input2.in", 4: "input3.in"}
+manifest = {"host": platform.platform(), "nproc": os.cpu_count(),
+            "mpirun": subprocess.run(["mpirun", "--version"],
+                                     capture_output=True, text=True
+                                     ).stdout.splitlines()[0],
+            "configs": {}}
+for cfg, inp in cfgs.items():
+    err = open(os.path.join(out_dir, f"oracle_{cfg}.err")).read()
+    m = re.search(r"Time taken: (\d+) ms", err)
+    outp = os.path.join(out_dir, f"oracle_{cfg}.out")
+    lines = sorted(open(outp).read().splitlines())
+    manifest["configs"][str(cfg)] = {
+        "bench": f"bench_{cfg}", "input": inp,
+        "input_sha256": sha(os.path.join(out_dir, inp)),
+        "np": int(nps[cfg - 1]),
+        "time_taken_ms": int(m.group(1)) if m else None,
+        "n_queries_reported": len(set(lines)),
+        "checksums_sha256": hashlib.sha256(
+            "\n".join(sorted(set(lines))).encode()).hexdigest(),
+        "out_file": f"oracle_{cfg}.out",
+    }
+path = os.path.join(out_dir, "ORACLE_GOLDEN.json")
+json.dump(manifest, open(path, "w"), indent=1)
+print(f">> wrote {path}")
+PY
+echo "Done. Copy $OUT_DIR back into the repo and run:"
+echo "  python tools/oracle_diff.py $OUT_DIR/ORACLE_GOLDEN.json"

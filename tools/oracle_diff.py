@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Diff captured oracle-binary checksums against this framework's engines.
+
+Consumes the ORACLE_GOLDEN.json + oracle_N.out files that
+tools/capture_oracle.sh recorded on an x86+OpenMPI host (the only manual
+step), re-generates the same seeded inputs with this repo's generator
+(byte-identity is asserted via the manifest's input sha256 — the two
+generators draw the same RNG sequence), runs the requested engine on each
+input, and compares per-query checksum SETS (order-insensitive: the
+reference's report loop is rank-serialized and the stripped binaries'
+exact interleaving is theirs to choose; the contract is the per-query
+checksum values, common.cpp:70).
+
+Usage:
+    python tools/oracle_diff.py oracle_capture/ORACLE_GOLDEN.json \
+        [--engine golden|single] [--configs 1,2,3,4]
+
+Exit 0 = every config's checksums match the reference binaries.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_checksum_lines(text: str) -> dict:
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        # "Query <id> checksum: <c>"
+        parts = line.split()
+        if len(parts) == 4 and parts[0] == "Query" and parts[2] == "checksum:":
+            out[int(parts[1])] = int(parts[3])
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("manifest")
+    ap.add_argument("--engine", default="golden",
+                    choices=["golden", "single"],
+                    help="what to diff against the binaries: the portable "
+                         "f64 golden model (default) or the JAX engine")
+    ap.add_argument("--configs", default="1,2,3,4")
+    args = ap.parse_args()
+
+    from dmlp_tpu.bench.configs import BENCH_CONFIGS
+    from dmlp_tpu.bench.harness import ensure_input
+    from dmlp_tpu.io.grammar import parse_input
+    from dmlp_tpu.io.report import format_results
+
+    cap_dir = os.path.dirname(os.path.abspath(args.manifest))
+    manifest = json.load(open(args.manifest))
+    failures = 0
+    for cfg_id in (int(c) for c in args.configs.split(",")):
+        rec = manifest["configs"].get(str(cfg_id))
+        if rec is None:
+            print(f"config {cfg_id}: not in manifest, skipping")
+            continue
+        cfg = BENCH_CONFIGS[cfg_id if cfg_id != 3 else 2]
+        inp_path = ensure_input(cfg, os.path.join(cap_dir, "repo_inputs"))
+        got_sha = hashlib.sha256(open(inp_path, "rb").read()).hexdigest()
+        if got_sha != rec["input_sha256"]:
+            print(f"config {cfg_id}: INPUT MISMATCH — repo regeneration "
+                  f"differs from the captured input ({got_sha[:12]} vs "
+                  f"{rec['input_sha256'][:12]}); generators diverged")
+            failures += 1
+            continue
+        oracle = parse_checksum_lines(
+            open(os.path.join(cap_dir, rec["out_file"])).read())
+        with open(inp_path, "rb") as f:
+            parsed = parse_input(f)
+        if args.engine == "golden":
+            from dmlp_tpu.golden.fast import knn_golden_fast
+            results = knn_golden_fast(parsed)
+        else:
+            from dmlp_tpu.config import EngineConfig
+            from dmlp_tpu.engine.single import SingleChipEngine
+            results = SingleChipEngine(
+                EngineConfig(use_pallas=True)).run(parsed)
+        ours = parse_checksum_lines(format_results(results, debug=False))
+        missing = sorted(set(oracle) - set(ours))
+        extra = sorted(set(ours) - set(oracle))
+        diff = sorted(q for q in set(oracle) & set(ours)
+                      if oracle[q] != ours[q])
+        if missing or extra or diff:
+            failures += 1
+            print(f"config {cfg_id}: MISMATCH — missing {len(missing)}, "
+                  f"extra {len(extra)}, differing {len(diff)} "
+                  f"(first differing: {diff[:5]})")
+        else:
+            print(f"config {cfg_id}: OK — {len(oracle)} query checksums "
+                  f"match bench_{cfg_id} (oracle "
+                  f"{rec['time_taken_ms']} ms at np={rec['np']})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
