@@ -97,6 +97,17 @@ class ShardedEngine:
 
     # -- sharded placement ---------------------------------------------------
     def _shard_inputs(self, inp: KNNInput, data_block: int, qgran: int = 8):
+        import time as _time
+        t0 = _time.perf_counter()
+        out = self._shard_inputs_inner(inp, data_block, qgran)
+        # Host-side staging enqueue (pad + convert + async device_put) —
+        # transfer wait lands in "fetch" like the other enqueue phases.
+        self.last_phase_ms["stage_enqueue"] = \
+            (_time.perf_counter() - t0) * 1e3
+        return out
+
+    def _shard_inputs_inner(self, inp: KNNInput, data_block: int,
+                            qgran: int = 8):
         r, c = self.mesh.devices.shape
         q = inp.params.num_queries
         na = inp.params.num_attrs
@@ -607,6 +618,8 @@ class ShardedEngine:
                                                      d_ids, q_attrs)
 
     def run(self, inp: KNNInput) -> List[QueryResult]:
+        import time as _time
+
         from dmlp_tpu.io.grammar import subset_queries
 
         n = inp.params.num_data
@@ -614,12 +627,19 @@ class ShardedEngine:
         self.last_repairs = 0  # tie-overflow repair rate, for bench records
         merged: List[QueryResult] = [None] * inp.params.num_queries
         dn_max = None
+        fetch_ms = final_ms = 0.0
         for top, _qpad, idx, select in segments:
             sub = inp if idx is None else subset_queries(inp, idx)
             nq = sub.params.num_queries
+            # Like engine.single.run: "fetch" includes the wait for all
+            # enqueued device work (staging + sharded solve + merge), not
+            # just readback bytes.
+            t0 = _time.perf_counter()
             dists = np.asarray(top.dists, np.float64)[:nq]
             labels = np.asarray(top.labels)[:nq]
             ids = np.asarray(top.ids)[:nq]
+            fetch_ms += (_time.perf_counter() - t0) * 1e3
+            t0 = _time.perf_counter()
             results = finalize_host(dists, labels, ids, sub.ks,
                                     sub.query_attrs, sub.data_attrs,
                                     exact=self.config.exact, query_ids=idx)
@@ -650,6 +670,9 @@ class ShardedEngine:
             else:
                 for local_i, orig in enumerate(idx):
                     merged[int(orig)] = results[local_i]
+            final_ms += (_time.perf_counter() - t0) * 1e3
+        self.last_phase_ms["fetch"] = fetch_ms
+        self.last_phase_ms["finalize"] = final_ms
         return merged
 
     def _fn_full(self, k: int, data_block: int, select: str,

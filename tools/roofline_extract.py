@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Roofline the on-chip extract solve (VERDICT r4 item 8).
+
+For the benchmark shape (200k x 10k x 64) at the ENGINE's own
+configuration — dtype="auto" resolves to bf16 staging on TPU, whose
+kcap = k + 96 + k/2 (resolve_kcap) — on the real chip:
+
+1. FLOOR (MXU): time a bare norm+matmul distance computation at the same
+   shape/precision (HIGHEST) — the achieved matmul rate bounds any fused
+   kernel from below, since the extraction kernel must do exactly this
+   matmul work.
+2. FLOOR (HBM): bytes the kernel must stream — every query tile re-reads
+   the full dataset: (Qb/tq) * B * A * 4 bytes — over the chip's HBM
+   bandwidth (v5e ~819 GB/s).
+3. MEASURED: the fenced extract solve (bench.time_fenced_solve_ms), plus
+   the kernel's own iteration diagnostics (extract_topk's iters output)
+   to size the VPU extraction term = measured - matmul floor.
+
+Verdict: measured vs max(floors); the gap decomposes into the extraction
+while-loop (VPU, scales with iterations) and scheduling overheads. Run in
+the DEFAULT env (real TPU); CPU runs are refused (meaningless numbers).
+
+Usage: python tools/roofline_extract.py [--out ROOFLINE_r05.json]
+       [--n 204800 --q 10240 --a 64 --k 32]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+HBM_GBPS = {"tpu v5 lite": 819.0, "v5e": 819.0}
+
+
+def fenced_ms(fn, reps=5):
+    import jax
+    outs = fn()
+    jax.block_until_ready(outs)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(ts)), float(np.min(ts))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="ROOFLINE_r05.json")
+    ap.add_argument("--n", type=int, default=204800)
+    ap.add_argument("--q", type=int, default=10240)
+    ap.add_argument("--a", type=int, default=64)
+    ap.add_argument("--k", type=int, default=32)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        print(f"FATAL: roofline needs the real chip, got {dev.platform}")
+        return 1
+
+    from dmlp_tpu.config import EngineConfig
+    from dmlp_tpu.engine.single import resolve_kcap
+    from dmlp_tpu.ops.pallas_extract import BLOCK_ROWS, extract_topk
+    from dmlp_tpu.ops.pallas_extract import _resolve_variant
+
+    n, q, a = args.n, args.q, args.a
+    cfg = EngineConfig(select="extract", use_pallas=True)
+    # Mirror the ENGINE's benchmark configuration exactly: dtype="auto"
+    # resolves to bfloat16 staging on TPU, so both the kcap (bf16 margin
+    # 96 + k/2) and the staged array dtype must be bf16 — rooflining an
+    # f32-fed kernel at a bf16 kcap would characterize a hybrid the
+    # engine never runs.
+    staging = cfg.resolve_dtype()
+    kc = resolve_kcap(cfg, args.k, "extract", n, staging=staging)
+    rng = np.random.default_rng(0)
+    wire = jnp.bfloat16 if staging == "bfloat16" else jnp.float32
+    d_dev = jnp.asarray(rng.uniform(0, 100, (n, a)).astype(np.float32),
+                        wire)
+    q_dev = jnp.asarray(rng.uniform(0, 100, (q, a)).astype(np.float32),
+                        wire)
+
+    # --- measured: one-shot whole-dataset kernel (resident data) --------
+    def solve():
+        od, oi, iters = extract_topk(q_dev, d_dev, n_real=n, id_base=0,
+                                     kc=kc)
+        return od, oi, iters
+
+    med_ms, min_ms = fenced_ms(solve)
+    _, _, iters = solve()
+    iters = np.asarray(iters)
+    total_iters = int(iters.sum())
+
+    # --- MXU floor: bare fused distance matmul at the same precision ----
+    @jax.jit
+    def dist_only(qa, da):
+        cross = jax.lax.dot_general(
+            qa, da, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+        qn = jnp.sum(qa * qa, -1, keepdims=True)
+        dn = jnp.sum(da * da, -1)[None, :]
+        # cheap epilogue so XLA can't elide the matmul; sum keeps HBM
+        # writeback of the (Q, N) matrix OUT of the floor (the kernel
+        # never writes it either)
+        return jnp.sum(jnp.maximum(qn + dn - 2.0 * cross, 0.0))
+
+    mxu_med, mxu_min = fenced_ms(lambda: dist_only(q_dev, d_dev))
+
+    # --- HBM floor ------------------------------------------------------
+    # Use the tile sizes extract_topk ACTUALLY resolves (_tile snaps to a
+    # divisor when the nominal tile doesn't divide the axis) — nominal
+    # sizes understate the floor for non-dividing shapes.
+    from dmlp_tpu.ops.pallas_distance import _tile
+    v = _resolve_variant(kc, n)
+    tq = _tile(q, v["tile_q"], 8)
+    tn = _tile(n, BLOCK_ROWS, 128 * v["ne"])
+    # The kernel upcasts staged bf16 to f32 BEFORE the pallas grid (the
+    # astype materializes f32 copies in HBM), so the repeated block sweep
+    # streams 4-byte elements regardless of the staging dtype (staging
+    # only halves the host->device transfer, which is outside this solve).
+    sweep_bytes = (q // tq) * n * a * 4 + (n // tn) * q * a * 4
+    bw = next((g for k_, g in HBM_GBPS.items()
+               if k_ in dev.device_kind.lower()), 819.0)
+    hbm_floor_ms = sweep_bytes / (bw * 1e9) * 1e3
+
+    flops = 2.0 * n * q * a
+    rec = {
+        "device": dev.device_kind, "shape": [n, q, a],
+        "k": args.k, "kc": kc, "variant": v,
+        "measured_solve_ms": {"median": round(med_ms, 2),
+                              "min": round(min_ms, 2)},
+        "mxu_floor_ms": {"median": round(mxu_med, 2),
+                         "min": round(mxu_min, 2),
+                         "achieved_tflops": round(
+                             flops / (mxu_min * 1e-3) / 1e12, 1)},
+        "hbm_floor_ms": round(hbm_floor_ms, 2),
+        "hbm_bw_gbps_assumed": bw,
+        "sweep_gb": round(sweep_bytes / 1e9, 2),
+        "extract_iters_total": total_iters,
+        "extract_iters_per_tile_sweep": round(
+            total_iters / max(iters.shape[0], 1), 1),
+        "extraction_term_ms": round(med_ms - mxu_med, 2),
+        "pct_of_roof": round(100.0 * max(mxu_min, hbm_floor_ms) / med_ms,
+                             1),
+    }
+    rec["verdict"] = (
+        f"binding floor = "
+        f"{'MXU' if mxu_min > hbm_floor_ms else 'HBM'} "
+        f"({max(mxu_min, hbm_floor_ms):.1f} ms); kernel at "
+        f"{rec['pct_of_roof']}% of roof; gap ~= extraction while-loop "
+        f"({rec['extraction_term_ms']} ms over {total_iters} iterations)")
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
