@@ -486,6 +486,12 @@ class ShardedEngine:
                 (top_o, qo_pad, out_idx, select_out)]
 
     def candidates(self, inp: KNNInput):
+        from dmlp_tpu.engine.single import staging_for_k
+        kmax = int(inp.ks.max()) if inp.params.num_queries else 0
+        with staging_for_k(self, kmax):
+            return self._candidates(inp)
+
+    def _candidates(self, inp: KNNInput):
         nq = inp.params.num_queries
         self.last_phase_ms = {}  # no stale phases if a path is skipped
         self.last_hetk = None    # routed=False below: no split ever fires
@@ -618,6 +624,12 @@ class ShardedEngine:
                                                      d_ids, q_attrs)
 
     def run(self, inp: KNNInput) -> List[QueryResult]:
+        from dmlp_tpu.engine.single import staging_for_k
+        kmax = int(inp.ks.max()) if inp.params.num_queries else 0
+        with staging_for_k(self, kmax):
+            return self._run(inp)
+
+    def _run(self, inp: KNNInput) -> List[QueryResult]:
         import time as _time
 
         from dmlp_tpu.io.grammar import subset_queries

@@ -123,6 +123,15 @@ def resolve_kcap(cfg: EngineConfig, kmax: int, select: str, cap: int,
         extra = max(extra, 8)
     if staging == "bfloat16" and cfg.exact:
         extra = max(extra, 96 + kmax // 2)
+    elif cfg.exact:
+        # f32 staging: the cancellation eps (finalize.staging_eps term 2)
+        # scales with qn + dn_max, not with k — at wide k the candidate
+        # horizon sits in a DENSE part of the distance spectrum and a
+        # constant 8-slot margin stops clearing it (measured at
+        # 204800 x 1024 x 64, k=4096 on v5e: 809/1024 queries flagged;
+        # k/8 extra slots -> 0 flagged, WIDEK_MP_r05). Slots are cheap;
+        # oracle repairs are ~30 ms/query.
+        extra = max(extra, kmax // 8)
     return max(min(round_up(kmax + extra, 8), cap), kmax)
 
 
@@ -200,6 +209,25 @@ def no_auto_coarsen(engine):
         yield
 
 
+# Widest kmax dtype="auto" may stage bf16 for. The bf16 kcap margin
+# (96 + k/2, resolve_kcap) was calibrated inside the extraction kernel's
+# window; far beyond it the margin stops clearing the bf16 eps on dense
+# distance spectra — measured on v5e at 204800 x 1024 x 64, k=4096
+# (WIDEK_MP_r05): EVERY query flags and the oracle repair (~32 s)
+# swamps the 2x staging-transfer win bf16 buys. Auto therefore prefers
+# exact-margin f32 staging for wide-k solves; an EXPLICIT
+# dtype="bfloat16" is still honored.
+_BF16_AUTO_K_CAP = 512
+
+
+def staging_for_k(engine, kmax: int):
+    """no_auto_coarsen-shaped context: swap dtype="auto" bf16 staging to
+    float32 for the duration of a wide-k solve (kmax > _BF16_AUTO_K_CAP)."""
+    if kmax > _BF16_AUTO_K_CAP:
+        return no_auto_coarsen(engine)
+    return contextlib.nullcontext()
+
+
 @functools.partial(jax.jit,
                    static_argnames=("chunk_rows", "k", "select", "use_pallas"))
 def _outlier_fold(carry: TopK, q_attrs, battrs, labels_all, lo, n_real, *,
@@ -255,6 +283,25 @@ def _extract_finalize(od, oi, glabels, *, k):
     n = glabels.shape[0]
     labels = jnp.where(oi >= 0, glabels[jnp.clip(oi, 0, max(n - 1, 0))], -1)
     return select_topk(od, labels, oi, k)
+
+
+@functools.partial(jax.jit, static_argnames=("staging", "na"))
+def _mp_floor(od, qn, dn_max, *, staging: str, na: int):
+    """Next-pass floor, computed ON DEVICE so passes chain without a host
+    readback (an inter-pass sync costs a full tunnel round trip per pass,
+    measured ~1.3 s of serialization at 9 passes). Ports
+    finalize.staging_eps: floor = max(od) - eps(max(od)); exhausted rows
+    (max = inf) get floor = +inf so later passes yield empty lists.
+    Returns (floor (Q, 1) f32, fd (Q,) f32 for post-hoc stall checks)."""
+    from dmlp_tpu.engine.finalize import (EPS_CANCEL_COEF, EPS_REL_BF16,
+                                          EPS_REL_F32)
+    fd = jnp.max(od, axis=1)
+    rel = EPS_REL_BF16 if staging == "bfloat16" else EPS_REL_F32
+    scale = qn + dn_max
+    eps = (rel * jnp.sqrt(jnp.maximum(fd, 0.0) * scale)
+           + EPS_CANCEL_COEF * (na + 2) * scale)
+    floor = jnp.where(jnp.isfinite(fd), fd - eps, jnp.inf)
+    return floor[:, None].astype(jnp.float32), fd
 
 
 @functools.partial(jax.jit, static_argnames=("kcap",))
@@ -593,45 +640,60 @@ class SingleChipEngine:
             throttle.tick(od)
         ods, ois = [od], [oi]
 
-        # Host-side floor/hazard bookkeeping (f64, like run()'s eps path).
-        qn = np.zeros(qpad, np.float64)
-        qn[:nq] = np.einsum("qa,qa->q", inp.query_attrs, inp.query_attrs)
+        # Floors chain ON DEVICE (_mp_floor): every pass enqueues without
+        # a host readback, so the whole P-pass sweep pipelines like the
+        # single-pass chunk driver. Stall detection moves post-hoc: the
+        # per-pass fd vectors come back in ONE readback at the end
+        # (plateau rows waste their later passes on duplicate lists —
+        # bounded by _MP_MAX_PASSES and caught below for exact repair).
+        qn_host = np.zeros(qpad, np.float64)
+        qn_host[:nq] = np.einsum("qa,qa->q", inp.query_attrs,
+                                 inp.query_attrs)
         dn_max = float(np.einsum("na,na->n", inp.data_attrs,
                                  inp.data_attrs).max())
-        stalled = np.zeros(qpad, bool)
-        exhausted = np.zeros(qpad, bool)
-        fd_prev = None
+        qn_dev = jnp.asarray(qn_host, jnp.float32)
+        # Passes 2..P sweep the RESIDENT dataset: one whole-array kernel
+        # dispatch per pass (the kernel grids over blocks internally)
+        # instead of nchunks dispatches — chunking only existed to
+        # overlap pass 1 with staging, and per-dispatch overhead on a
+        # tunneled link is ~0.25 s (36 -> 9 dispatches at the 204800,
+        # 9-pass shape). The concat is one on-device copy (~dataset
+        # bytes), well under the resident budget.
+        d_full = chunks[0][0] if len(chunks) == 1 \
+            else jnp.concatenate([c[0] for c in chunks], axis=0)
+        del chunks  # free the duplicate once the concat is enqueued —
+        # otherwise the dataset is HBM-resident TWICE for the whole sweep
+        fds = []
         for _p in range(1, npasses):
-            last_od = ods[-1]
-            fd = np.asarray(jax.device_get(jnp.max(last_od, axis=1)),
-                            np.float64)
-            exhausted |= ~np.isfinite(fd)
-            if fd_prev is not None:
-                stalled |= np.isfinite(fd) & (fd <= fd_prev)
-            fd_prev = fd
-            if np.all(exhausted | stalled):
-                break  # nothing left to find / floors pinned by plateaus
-            eps = staging_eps(np.where(np.isfinite(fd), fd, 0.0), qn,
-                              dn_max, self._staging, na)
-            floor = np.where(np.isfinite(fd), fd - eps, np.inf)
-            floor_dev = jnp.asarray(floor[:, None], jnp.float32)
-            od = oi = None
-            for da, lo, hi in chunks:
-                od, oi, _ = extract_topk(q_dev, da, od, oi, n_real=hi - lo,
-                                         id_base=lo, kc=kc,
-                                         interpret=interpret,
-                                         floor=floor_dev)
-                throttle.tick(od)
+            floor_dev, fd = _mp_floor(ods[-1], qn_dev, dn_max,
+                                      staging=self._staging, na=na)
+            fds.append(fd)
+            od, oi, _ = extract_topk(q_dev, d_full, n_real=n, id_base=0,
+                                     kc=kc, interpret=interpret,
+                                     floor=floor_dev)
+            throttle.tick(od)
             ods.append(od)
             ois.append(oi)
+        # Final pass's fd too: a plateau pinning the LAST boundary must
+        # flag as well (its ties are the one loss the outer boundary test
+        # can miss when kcap >= n).
+        fds.append(_mp_floor(ods[-1], qn_dev, dn_max,
+                             staging=self._staging, na=na)[1])
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
         self.last_mp_passes = len(ods)
 
         top, valid = _mp_merge(jnp.concatenate(ods, axis=1),
                                jnp.concatenate(ois, axis=1),
                                jnp.asarray(inp.labels), kcap=kcap)
+        # One fence for everything: fd sequence (stall check), final
+        # valid counts (shortfall check).
+        fetched = jax.device_get([valid] + fds)
+        valid_h, fd_h = fetched[0], fetched[1:]
+        stalled = np.zeros(qpad, bool)
+        for prev, cur in zip(fd_h, fd_h[1:]):
+            stalled |= np.isfinite(cur) & (cur <= prev)
         needed = np.minimum(inp.ks.astype(np.int64), n)
-        shortfall = np.asarray(jax.device_get(valid))[:nq] < needed
+        shortfall = np.asarray(valid_h)[:nq] < needed
         self._mp_hazard = stalled[:nq] | shortfall
         return [(top, qpad, None, "extract")]
 
@@ -758,7 +820,9 @@ class SingleChipEngine:
 
     def candidates(self, inp: KNNInput) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Device pass: (Q, K) selection-ordered candidate lists as NumPy."""
-        out, qpad = self._solve(inp)
+        kmax = int(inp.ks.max()) if inp.params.num_queries else 0
+        with staging_for_k(self, kmax):
+            out, qpad = self._solve(inp)
         nq = inp.params.num_queries
         dists = np.asarray(out.dists, np.float64)[:nq]
         labels = np.asarray(out.labels)[:nq]
@@ -778,6 +842,11 @@ class SingleChipEngine:
         anyway); the (Q, K) f32 distance matrix is fetched only in fast
         mode, where it is the result.
         """
+        kmax = int(inp.ks.max()) if inp.params.num_queries else 0
+        with staging_for_k(self, kmax):
+            return self._run(inp)
+
+    def _run(self, inp: KNNInput) -> List[QueryResult]:
         import time as _time
 
         n = inp.params.num_data

@@ -342,9 +342,17 @@ def sharded_solve_from_file(path: str, engine):
     caller finalizes. For the full contract run (distributed f64 rescore +
     rank-0 report) use distributed_contract_run instead.
     """
-    ga, gl, gi, gq, params, ks, _ = stage_global_inputs(path, engine)
+    from dmlp_tpu.engine.single import staging_for_k
+
+    parsed = read_local_inputs(path, engine)
+    params, ks = parsed["params"], parsed["ks"]
     kmax = int(ks.max()) if params.num_queries else 1
-    top = engine.solve_global(ga, gl, gi, gq, kmax)
+    # Wide-k solves stage f32 under dtype="auto" (engine.single
+    # .staging_for_k): the context spans placement AND solve so the
+    # staged wire dtype, the kcap margin, and the hazard eps agree.
+    with staging_for_k(engine, kmax):
+        ga, gl, gi, gq = place_global_inputs(engine, parsed)
+        top = engine.solve_global(ga, gl, gi, gq, kmax)
     return top, params, ks
 
 
@@ -498,7 +506,7 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
         local_s = dict(local, query_attrs=q64_seg)
         my_d, my_l, my_i = rescore_local_shards(
             top, local_s, ks_seg, nqs,
-            staging=engine.config.resolve_dtype())
+            staging=engine._staging)
 
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
@@ -522,7 +530,7 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
         nq = params.num_queries
         n = params.num_data
         r = engine.mesh.devices.shape[0]
-        split = hetk_split(engine.config, engine.config.resolve_dtype(),
+        split = hetk_split(engine.config, engine._staging,
                            ks, n, round_up(max(-(-n // r), 1), 8))
         if split is None:
             ga, gl, gi, gq = place_global_inputs(engine, parsed)
@@ -545,14 +553,17 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
                 merged[res.query_id] = res
         return merged
 
-    if warmup:
-        solve()
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("dmlp_tpu.contract.start")
-    t0 = time.perf_counter()
-    results = solve()
-    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    from dmlp_tpu.engine.single import staging_for_k
+    kmax_all = int(ks.max()) if params.num_queries else 0
+    with staging_for_k(engine, kmax_all):
+        if warmup:
+            solve()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("dmlp_tpu.contract.start")
+        t0 = time.perf_counter()
+        results = solve()
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
     if jax.process_index() == 0:
         out.write(format_results(results, debug=engine.config.debug))
         err.write(f"Time taken: {int(round(elapsed_ms))} ms\n")

@@ -331,3 +331,40 @@ class TestMultipassExtract:
         assert eng.last_hetk is not None
         assert getattr(eng, "_mp_hazard", None) is None
         assert_same_results(got, knn_golden(inp))
+
+
+def test_auto_staging_prefers_f32_for_wide_k(monkeypatch):
+    """WIDEK_MP_r05 measurement: beyond the kernel window the bf16 kcap
+    margin stops clearing the bf16 eps (100% oracle-repair rate at
+    204800x1024, k=4096 on v5e), so dtype="auto" must stage f32 for
+    wide-k solves; explicit dtype="bfloat16" stays honored."""
+    import jax.numpy as jnp
+
+    from dmlp_tpu.engine.single import staging_for_k
+
+    monkeypatch.setattr(EngineConfig, "resolve_dtype",
+                        lambda self: "bfloat16" if self.dtype == "auto"
+                        else self.dtype)
+    eng = SingleChipEngine(EngineConfig(dtype="auto"))
+    assert eng._staging == "bfloat16"
+    with staging_for_k(eng, 512):
+        assert eng._staging == "bfloat16"  # at the cap: bf16 stays
+    with staging_for_k(eng, 513):
+        assert eng._staging == "float32"   # beyond: auto prefers f32
+        assert eng._dtype == jnp.float32
+    assert eng._staging == "bfloat16"      # restored
+
+    # explicit bf16 is the caller's choice — never overridden
+    eng2 = SingleChipEngine(EngineConfig(dtype="bfloat16"))
+    with staging_for_k(eng2, 4096):
+        assert eng2._staging == "bfloat16"
+
+    # end-to-end: a wide-k run under forced-bf16 auto resolution must
+    # still match golden (it stages f32 internally now)
+    text = generate_input_text(1400, 4, 4, -3, 3, 700, 800, 3, seed=2)
+    inp = parse_input_text(text)
+    eng3 = SingleChipEngine(EngineConfig(select="extract", use_pallas=True,
+                                         dtype="auto"))
+    assert eng3._staging == "bfloat16"
+    got = eng3.run(inp)
+    assert_same_results(got, knn_golden(inp))

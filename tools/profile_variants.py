@@ -6,8 +6,11 @@ profile_amortized.py."""
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -36,17 +39,24 @@ def main() -> int:
     for dblock in (51200, 204800):
         nseg = dblock // 128
         s = min(nseg, k + 16)
-        rng = np.random.default_rng(0)
-        tile = jnp.abs(jnp.asarray(
-            rng.standard_normal((nq, dblock)), jnp.float32)) * 100
-        segmin = tile.reshape(nq, nseg, 128).min(axis=-1)
+        # The gather variants materialize a perturbed copy of the tile, so
+        # the big block needs 2x tile bytes on device: halve the query
+        # rows there (per-query costs are linear in nq; the variant
+        # RANKING this tool exists for is unchanged) and generate on
+        # device — a host f64 standard_normal of (10240, 204800) is
+        # 16.8 GB of host RAM for no reason.
+        nq_b = nq // 2 if dblock >= 204800 else nq
+        tile = jnp.abs(jax.random.normal(
+            jax.random.PRNGKey(0), (nq_b, dblock), jnp.float32)) * 100
+        segmin = tile.reshape(nq_b, nseg, 128).min(axis=-1)
         seg_idx = jax.lax.top_k(-segmin, s)[1]
         cand = jnp.take_along_axis(
-            tile.reshape(nq, nseg, 128), seg_idx[:, :, None], axis=1
-        ).reshape(nq, s * 128)
-        carry = jnp.zeros((nq, k), jnp.float32)
+            tile.reshape(nq_b, nseg, 128), seg_idx[:, :, None], axis=1
+        ).reshape(nq_b, s * 128)
+        carry = jnp.zeros((nq_b, k), jnp.float32)
         float(jnp.sum(cand))
-        tag = f"b{dblock}"
+        tag = f"b{dblock}_q{nq_b}"  # nq in the key: cross-round
+        # artifacts must not be conflated when the workload halves
 
         # seg_topk at this nseg
         out[f"{tag}/seg_topk_{nseg}_to_{s}"] = amortized(
@@ -55,16 +65,16 @@ def main() -> int:
         # gather variants
         out[f"{tag}/gather_take_along"] = amortized(
             lambda e, t, si: jnp.sum(jnp.take_along_axis(
-                (t + e).reshape(nq, nseg, 128), si[:, :, None], axis=1)),
+                (t + e).reshape(nq_b, nseg, 128), si[:, :, None], axis=1)),
             tile, seg_idx)
 
         def gather_onehot(e, t, si):
             oh = (si[:, :, None] == jnp.arange(nseg)[None, None, :]
-                  ).astype(jnp.float32)          # (nq, s, nseg)
+                  ).astype(jnp.float32)          # (nq_b, s, nseg)
             g = jax.lax.dot_general(
-                oh, (t + e).reshape(nq, nseg, 128),
+                oh, (t + e).reshape(nq_b, nseg, 128),
                 (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)  # (nq, s, 128)
+                preferred_element_type=jnp.float32)  # (nq_b, s, 128)
             return jnp.sum(g)
         out[f"{tag}/gather_onehot_matmul"] = amortized(
             gather_onehot, tile, seg_idx)
@@ -76,16 +86,16 @@ def main() -> int:
             carry, cand)
 
         def merge_2stage(e, c, cd):
-            c3 = (cd + e).reshape(nq, s, 128)
+            c3 = (cd + e).reshape(nq_b, s, 128)
             t1 = jax.lax.top_k(-c3, k)[0]            # (nq, s, k)
-            allc = jnp.concatenate([c, -t1.reshape(nq, s * k)], axis=-1)
+            allc = jnp.concatenate([c, -t1.reshape(nq_b, s * k)], axis=-1)
             return jnp.sum(jax.lax.top_k(-allc, k)[0])
         out[f"{tag}/merge_2stage"] = amortized(merge_2stage, carry, cand)
 
         def merge_sortseg(e, c, cd):
-            c3 = jax.lax.sort((cd + e).reshape(nq, s, 128), dimension=-1)
+            c3 = jax.lax.sort((cd + e).reshape(nq_b, s, 128), dimension=-1)
             t1 = c3[:, :, :k]
-            allc = jnp.concatenate([c, t1.reshape(nq, s * k)], axis=-1)
+            allc = jnp.concatenate([c, t1.reshape(nq_b, s * k)], axis=-1)
             return jnp.sum(jax.lax.top_k(-allc, k)[0])
         out[f"{tag}/merge_sortseg"] = amortized(merge_sortseg, carry, cand)
 
@@ -102,12 +112,17 @@ def main() -> int:
     float(jnp.sum(d))
     import functools
     for db in (51200, 102400, 204800):
+        # One-big-chunk at full Q needs ~2x the 8.4 GB live tile in HBM
+        # (tile + selection temps) — compile OOM on a 16 GB chip; halve
+        # the query rows there (linear in Q, ranking unchanged).
+        qe = q[: nq // 2] if db >= 204800 else q
         fn = jax.jit(functools.partial(
             streaming_topk, k=k, data_block=db, select="seg",
             use_pallas=native))
-        out[f"solve_seg_dblock{db}"] = amortized(
-            lambda e, q, d, l, i, _fn=fn: jnp.sum(_fn(q + e, d, l, i).dists),
-            q, d, lab, ids, repeats=3)
+        out[f"solve_seg_dblock{db}_q{qe.shape[0]}"] = amortized(
+            lambda e, qq, d, l, i, _fn=fn: jnp.sum(
+                _fn(qq + e, d, l, i).dists),
+            qe, d, lab, ids, repeats=3)
 
     print(json.dumps(out, indent=1))
     return 0
