@@ -298,14 +298,33 @@ def main() -> int:
         # What the baseline IS (VERDICT r4 weak #4: the bare ratio invited
         # over-reading): a measured same-host BLAS argpartition KNN solve,
         # query-subsampled and linearly extrapolated — NOT the reference's
-        # MPI binaries, which need an x86+OpenMPI host (capture them with
-        # tools/capture_oracle.sh).
+        # MPI binaries (for those see vs_reference_binary below).
         "baseline_kind": "host_cpu_blas_knn_extrapolated",
         "qd_pairs_per_sec": round(pairs_per_s),
         "shape": {"num_data": num_data, "num_queries": num_queries,
                   "num_attrs": num_attrs, "k": k, "mode": mode},
         "path": path,
     }
+    # MEASURED reference-binary comparison, when a capture exists for this
+    # exact shape (tools/capture_oracle.sh; bench_4's config IS the bench
+    # workload spec). This is the real thing the estimated ratio above is
+    # not: the reference's own stripped engine, run in THIS container via
+    # isolated-singleton Open MPI, checksum-parity-verified against this
+    # framework (oracle_capture/ORACLE_GOLDEN.json, tools/oracle_diff.py).
+    cap = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "oracle_capture", "ORACLE_GOLDEN.json")
+    if (os.path.exists(cap)
+            and (num_data, num_queries, num_attrs, k)
+            == (200_000, 10_000, 64, 32)):
+        try:
+            with open(cap) as f:
+                ref = json.load(f)["configs"]["4"]
+            out["reference_binary_ms"] = ref["time_taken_ms"]
+            out["reference_binary_np"] = ref["np"]
+            out["vs_reference_binary"] = round(
+                ref["time_taken_ms"] / engine_ms, 1)
+        except (KeyError, json.JSONDecodeError):
+            pass
     # Promote the fenced on-chip number: `value` includes host<->device
     # transfers, which on a tunneled link (10-50 MB/s measured) swing 2-4x
     # with link weather; the device solve is the architecture-bound,
