@@ -278,7 +278,7 @@ def _boundary_cols(dists, ks):
 def _extract_finalize(od, oi, glabels, *, k):
     """Extraction-kernel epilogue: gather labels from global ids and sort
     the (unordered) running lists into the golden selection order
-    (dist asc, label desc, id desc) — a tiny (Q, K) composite sort."""
+    (dist asc, id desc) — a tiny (Q, K) composite sort."""
     from dmlp_tpu.ops.topk import select_topk
     n = glabels.shape[0]
     labels = jnp.where(oi >= 0, glabels[jnp.clip(oi, 0, max(n - 1, 0))], -1)
@@ -310,7 +310,7 @@ def _mp_merge(dists, ids, glabels, *, kcap):
     lists -> dedup by id (eps-overlapped floors re-extract boundary
     candidates on purpose; duplicates carry identical device distances,
     so id-identity is the whole test) -> gather labels -> composite-sort
-    to the final (Q, kcap) selection order. Also returns the per-row
+    to the final (Q, kcap) selection order (dist asc, id desc). Also returns the per-row
     valid-candidate count for the driver's shortfall check."""
     from dmlp_tpu.ops.topk import select_topk
     order = jnp.argsort(ids, axis=1)

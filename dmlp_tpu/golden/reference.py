@@ -1,28 +1,34 @@
 """Pure-NumPy golden KNN model — the portable differential-testing oracle.
 
 The reference verifies engines against four stripped x86/MPI oracle binaries
-(benchmarks/bench_1..4, survey §4); those cannot execute in a TPU-host image
-(no orted — verified), so this module is the portable oracle every engine is
-diffed against, implementing the *intended* semantics of engine.cpp exactly:
+(benchmarks/bench_1..4, survey §4). Build round 5 ran those binaries in this
+container (isolated-singleton Open MPI; tools/capture_oracle.sh) and MEASURED
+their semantics on tie-adversarial inputs, so this oracle implements the
+binaries' observed contract, not the author engine.cpp's:
 
 - squared Euclidean distance, float64, difference form (engine.cpp:12-18);
-- k-selection comparator: distance asc, tie -> **larger label** first
-  (engine.cpp:251-254 and the identical merge comparator at :302-305);
+- k-selection comparator: distance asc, tie -> **larger id** first —
+  LABEL-FREE. The author's engine.cpp breaks selection ties by larger label
+  (engine.cpp:251-254), but the actual oracle binaries bench_1/2/3 match
+  the label-free order exactly on 300/300 tie-adversarial fuzz cases
+  (TIE_SEMANTICS_r05.json), while the label-aware order mismatched 18% of
+  cases in the discovery census; bench_4 disagrees with its own siblings
+  on ties — id-ASC report order — so the majority semantics is the
+  contract;
 - majority vote over the selected k with tie -> **larger label**
-  (engine.cpp:326-332);
-- report order: distance asc, tie -> **larger id** first (engine.cpp:334-338);
+  (engine.cpp:326-332; confirmed on the binaries with crafted vote-tie
+  inputs);
+- report order: distance asc, tie -> **larger id** first (engine.cpp:334-338;
+  identical to the selection order — one comparator governs both);
 - pad with the id = -1 sentinel when fewer than k candidates exist
   (common.cpp:66); padded entries carry dist = +inf and do not vote.
 
-Deterministic refinement: the C++ selection comparator does not inspect ids,
-so candidates equal in (distance, label) across the k-boundary are chosen
-unspecifiedly by ``std::nth_element``. This oracle (and every engine in this
-framework) refines the order to (distance asc, label desc, **id desc**) — a
-strict total order, which also makes blockwise top-k + merge exactly equal to
-the global top-k (the property the sharded/ring engines rely on). Known
-defects of the author's engine are deliberately not inherited (survey §7
-quirks Q1-Q3: wrong merge offsets for heterogeneous k, zero-padding of short
-shards, duplicated report loop).
+On tie-free inputs — every graded benchmark input; continuous draws tie with
+probability ~0 — the label-free and label-aware orders coincide, which is
+why all 21,000 captured benchmark checksums match either way
+(oracle_capture/ORACLE_GOLDEN.json). Known defects of the author's engine
+are deliberately not inherited (survey §7 quirks Q1-Q3: wrong merge offsets
+for heterogeneous k, zero-padding of short shards, duplicated report loop).
 """
 
 from __future__ import annotations
@@ -36,8 +42,18 @@ from dmlp_tpu.io.report import QueryResult, format_results
 
 
 def _select_order(dists: np.ndarray, labels: np.ndarray, ids: np.ndarray) -> np.ndarray:
-    """Indices sorting by the selection total order (dist asc, label desc, id desc)."""
-    return np.lexsort((-ids, -labels, dists))
+    """Indices sorting by the selection total order (dist asc, id desc).
+
+    Labels play no role in selection — measured, not assumed: build round
+    5 ran the actual oracle binaries (isolated-singleton Open MPI) on
+    tie-adversarial inputs and bench_1/2/3 match this label-free order
+    exactly (0/300 mismatches; TIE_SEMANTICS_r05.json), while the
+    author's engine.cpp label-aware comparator (engine.cpp:251-254)
+    mismatched 18% in the discovery census. (bench_4 orders report ties
+    id-ASC — inconsistent with its own siblings; see the artifact.)
+    ``labels`` stays in the signature for call-site symmetry."""
+    del labels
+    return np.lexsort((-ids, dists))
 
 
 def vote(labels: np.ndarray) -> int:
@@ -58,7 +74,7 @@ def finalize_query(drow: np.ndarray, labels: np.ndarray, ids: np.ndarray,
     """Candidate distances for one query -> its final QueryResult.
 
     THE definition of the output contract, shared by the strict and fast
-    oracles: select by (dist asc, label desc, id desc), vote (tie -> larger
+    oracles: select by (dist asc, id desc), vote (tie -> larger
     label), report order (dist asc, id desc), pad to k with the id = -1 /
     dist = +inf sentinel (common.cpp:66). ``drow``/``labels``/``ids`` may be
     the full dataset row or any candidate subset that contains the true
@@ -67,8 +83,10 @@ def finalize_query(drow: np.ndarray, labels: np.ndarray, ids: np.ndarray,
     order = _select_order(drow, labels, ids)[: min(k, drow.shape[0])]
     sel_d, sel_l, sel_i = drow[order], labels[order], ids[order]
     predicted = vote(sel_l)
-    ro = np.lexsort((-sel_i, sel_d))
-    out_ids, out_dists = sel_i[ro], sel_d[ro]
+    # Selection order IS the report order under the measured label-free
+    # comparator (one (dist asc, id desc) total order governs both) —
+    # no second sort.
+    out_ids, out_dists = sel_i, sel_d
     if out_ids.size < k:
         pad = k - out_ids.size
         out_ids = np.concatenate([out_ids, np.full(pad, -1, np.int64)])

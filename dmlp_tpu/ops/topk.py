@@ -1,16 +1,16 @@
 """Exact-tie-break k-selection and blockwise merge.
 
-The correctness contract is order-sensitive (checksums, survey §4), and the
-reference's comparators are exotic: selection ties break to the **larger
-label** (engine.cpp:251-254), final report ties to the **larger id**
-(engine.cpp:334-338). ``jax.lax.top_k`` breaks ties by lowest index, so it
-cannot express this; instead selection is a multi-operand ``jax.lax.sort``
-over the composite key
+The correctness contract is order-sensitive (checksums, survey §4). The
+MEASURED oracle-binary comparator (r5: the binaries ran in-container and
+were fuzzed on tie-adversarial inputs, golden.reference docstring /
+TIE_SEMANTICS_r05.json) breaks both selection and report ties to the
+**larger id**, label-free. ``jax.lax.top_k`` breaks ties by lowest index,
+so it cannot express this; instead selection is a multi-operand
+``jax.lax.sort`` over the composite key
 
-    (distance asc, label desc, id desc)
+    (distance asc, id desc)
 
-— a strict total order (the id refinement makes ties deterministic where the
-C++ ``nth_element`` left them unspecified; see dmlp_tpu.golden.reference).
+— a strict total order (see dmlp_tpu.golden.reference).
 Totality is what makes blockwise selection exact: top-k of a union equals
 top-k of concatenated per-block top-k's, so the same primitive implements the
 local select (engine.cpp:249-256), the root merge (engine.cpp:300-307), the
@@ -46,7 +46,9 @@ class TopK(NamedTuple):
 
 def select_topk(dists: jax.Array, labels: jax.Array, ids: jax.Array,
                 k: int) -> TopK:
-    """Select the k best (dist asc, label desc, id desc) along the last axis.
+    """Select the k best (dist asc, id desc) along the last axis — the
+    MEASURED oracle-binary comparator (label-free; golden.reference
+    docstring / TIE_SEMANTICS_r05.json), identical to the report order.
 
     ``labels``/``ids`` broadcast against ``dists`` (e.g. (N,) vs (Q, N)).
     If k exceeds the axis size, results are padded with (+inf, -1, -1).
@@ -62,10 +64,11 @@ def select_topk(dists: jax.Array, labels: jax.Array, ids: jax.Array,
         labels = jnp.concatenate(
             [labels, jnp.full(shape, -1, labels.dtype)], axis=-1)
         ids = jnp.concatenate([ids, jnp.full(shape, -1, ids.dtype)], axis=-1)
-    # Ascending lexicographic sort on (dist, -label, -id): exactly the
-    # selection total order. num_keys=3 keeps everything int32/f32 (no x64).
-    sd, _, _, sl, si = jax.lax.sort(
-        (dists, -labels, -ids, labels, ids), num_keys=3, dimension=-1)
+    # Ascending lexicographic sort on (dist, -id): exactly the selection
+    # total order; labels ride as payload. num_keys=2 (was 3 when
+    # selection was label-aware) keeps everything int32/f32 (no x64).
+    sd, _, sl, si = jax.lax.sort(
+        (dists, -ids, labels, ids), num_keys=2, dimension=-1)
     return TopK(sd[..., :k], sl[..., :k], si[..., :k])
 
 
@@ -97,7 +100,7 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
     ``select`` picks the per-step merge: "sort" is the strict total order
     (reference tie semantics on device); "topk" is a ``lax.top_k`` partial
     reduce — ~4x faster on TPU, exact by distance, but distance ties keep
-    the lowest *position* instead of the reference's (label desc, id desc)
+    the lowest *position* instead of the reference's larger-id
     preference. That matters only when a tie group straddles the candidate
     boundary k: the kept candidates may then exclude the preferred ones, a
     loss no downstream rescore can undo. Engines detect that hazard on host

@@ -44,7 +44,7 @@ def majority_vote(labels: jax.Array, valid: jax.Array,
 def report_order(topk: TopK, ks: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Mask each query's list to its own k and sort for reporting.
 
-    ``topk`` lists are selection-ordered (dist asc, label desc, id desc), so
+    ``topk`` lists are selection-ordered (dist asc, id desc), so
     the first k_q entries *are* query q's top-k_q; entries beyond k_q are
     invalidated (dist=+inf, id=-1) and the list re-sorted by the report order
     (dist asc, id desc). Returns (dists, ids, valid) with valid marking the

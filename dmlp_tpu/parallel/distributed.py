@@ -359,12 +359,13 @@ def sharded_solve_from_file(path: str, engine):
 def _exact_shard_topk(q64: np.ndarray, d64: np.ndarray, labels: np.ndarray,
                       id_base: np.ndarray, k: int):
     """Exact f64 top-k of one query over one data shard, by the selection
-    total order (dist asc, label desc, id desc). The per-query repair for
+    total order (dist asc, id desc — the measured label-free
+    oracle-binary comparator, golden.reference). The per-query repair for
     f32 tie-boundary hazards — all inputs are local to the owning process.
     """
     diff = d64 - q64[None, :]
     dist = np.einsum("na,na->n", diff, diff)
-    order = np.lexsort((-id_base, -labels, dist))[:k]
+    order = np.lexsort((-id_base, dist))[:k]
     out_d = np.full(k, np.inf)
     out_l = np.full(k, -1, np.int32)
     out_i = np.full(k, -1, np.int32)

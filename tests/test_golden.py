@@ -24,8 +24,9 @@ def naive_solve(inp: KNNInput):
         for di in range(inp.params.num_data):
             d = float(((inp.query_attrs[qi] - inp.data_attrs[di]) ** 2).sum())
             cands.append((d, int(inp.labels[di]), di))
-        # selection order: dist asc, label desc, id desc
-        cands.sort(key=lambda t: (t[0], -t[1], -t[2]))
+        # selection order: dist asc, id desc (the MEASURED oracle-binary
+        # comparator — label-free; golden.reference docstring)
+        cands.sort(key=lambda t: (t[0], -t[2]))
         sel = cands[:k]
         counts = collections.Counter(lab for _, lab, _ in sel)
         pred = max(counts.items(), key=lambda kv: (kv[1], kv[0]))[0] if sel else -1
@@ -56,15 +57,18 @@ def test_golden_matches_naive_random(seed):
 
 
 def test_tie_breaking_duplicate_points():
-    # Four identical points: distance ties everywhere. Selection must prefer
-    # larger label, then larger id; report must order by larger id.
+    # Four identical points: distance ties everywhere. Selection is
+    # LABEL-FREE (dist asc, id desc) — verified against the actual oracle
+    # binary bench_1 run in-container on THIS input (r5 tie-semantics
+    # measurement): it selects ids [3, 2]; vote ties 0-vs-3 -> larger
+    # label 3; checksum below is bench_1's own output.
     inp = make_input(labels=[1, 3, 3, 0],
                      data=[[0.0], [0.0], [0.0], [0.0]],
                      ks=[2], queries=[[0.0]])
     (r,) = knn_golden(inp)
-    # label-3 points (ids 1,2) win selection; id desc among them in report.
-    assert list(r.neighbor_ids) == [2, 1]
+    assert list(r.neighbor_ids) == [3, 2]
     assert r.predicted_label == 3
+    assert r.checksum() == 10328283706273687613  # bench_1, measured
     naive = naive_solve(inp)
     assert (r.predicted_label, list(r.neighbor_ids)) == naive[0]
 

@@ -1,7 +1,7 @@
 """The fast "topk" selection path: parity incl. adversarial tie overflow.
 
 The ``lax.top_k`` path keeps distance ties by position, not by the
-reference's (label desc, id desc) preference (dmlp_tpu.ops.topk). These
+reference's larger-id preference (dmlp_tpu.ops.topk). These
 tests force ``select="topk"`` (every other test resolves "auto" -> "sort"
 at test sizes) and cover the case code review flagged: a duplicate tie
 group larger than k + margin straddling the candidate boundary, where the
