@@ -311,20 +311,12 @@ def main() -> int:
     # not: the reference's own stripped engine, run in THIS container via
     # isolated-singleton Open MPI, checksum-parity-verified against this
     # framework (oracle_capture/ORACLE_GOLDEN.json, tools/oracle_diff.py).
-    cap = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "oracle_capture", "ORACLE_GOLDEN.json")
-    if (os.path.exists(cap)
-            and (num_data, num_queries, num_attrs, k)
-            == (200_000, 10_000, 64, 32)):
-        try:
-            with open(cap) as f:
-                ref = json.load(f)["configs"]["4"]
-            out["reference_binary_ms"] = ref["time_taken_ms"]
-            out["reference_binary_np"] = ref["np"]
-            out["vs_reference_binary"] = round(
-                ref["time_taken_ms"] / engine_ms, 1)
-        except (KeyError, json.JSONDecodeError):
-            pass
+    if (num_data, num_queries, num_attrs, k) == (200_000, 10_000, 64, 32):
+        from dmlp_tpu.bench.harness import reference_binary_fields
+        out.update(reference_binary_fields(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "oracle_capture", "ORACLE_GOLDEN.json"),
+            4, engine_ms))
     # Promote the fenced on-chip number: `value` includes host<->device
     # transfers, which on a tunneled link (10-50 MB/s measured) swing 2-4x
     # with link weather; the device solve is the architecture-bound,
