@@ -373,7 +373,10 @@ def run_config(config_id: int, base_dir: str = ".",
     # config (tools/capture_oracle.sh ran bench_1..4 in-container via
     # isolated-singleton Open MPI; configs 1-4 map 1:1 onto the captured
     # workloads; config 5's input has no captured binary counterpart).
-    if config_id in (1, 2, 3, 4) and res["engine_ms"]:
+    # (checksums_match gate: a wrong-output run's timing must not carry a
+    # reference-binary multiple either.)
+    if config_id in (1, 2, 3, 4) and res["engine_ms"] \
+            and res["checksums_match"]:
         res.update(reference_binary_fields(
             os.path.join(base_dir, "oracle_capture", "ORACLE_GOLDEN.json"),
             config_id, res["engine_ms"]))
@@ -392,14 +395,17 @@ def reference_binary_fields(cap_path: str, config_id: int,
     try:
         with open(cap_path) as f:
             ref = _json.load(f)["configs"][str(config_id)]
-        ref_ms = float(ref["time_taken_ms"])
+        ref_ms = float(ref["time_taken_ms"])  # validate; store raw below
         ref_np = int(ref["np"])
     except (OSError, KeyError, TypeError, ValueError,
             _json.JSONDecodeError):
         return {}
-    if not engine_ms or ref_ms <= 0:
+    # `not (ref_ms > 0)` also rejects NaN (NaN <= 0 is False) — a NaN
+    # multiple would serialize as invalid strict JSON downstream.
+    if not engine_ms or not (ref_ms > 0):
         return {}
-    return {"reference_binary_ms": ref_ms, "reference_binary_np": ref_np,
+    return {"reference_binary_ms": ref["time_taken_ms"],
+            "reference_binary_np": ref_np,
             "vs_reference_binary": round(ref_ms / engine_ms, 1)}
 
 
