@@ -5,7 +5,7 @@
 CXX ?= g++
 CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
-.PHONY: all native test bench clean
+.PHONY: all native test bench obs-smoke clean
 
 all: native
 
@@ -14,12 +14,28 @@ native: native/_fastparse.so
 native/_fastparse.so: native/fastparse.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
-test:
+test: obs-smoke
 	python -m pytest tests/ -q
 
 # One-line JSON benchmark on the current backend (TPU under the default env).
 bench:
 	python bench.py
+
+# Observability smoke: run bench config 1 through the real CLI with
+# --trace/--metrics on CPU, then validate the artifacts' structural
+# contract (Perfetto-loadable spans; summary record with cost-analysis
+# counters or the explicit counters_unavailable marker).
+obs-smoke:
+	mkdir -p outputs
+	JAX_PLATFORMS=cpu python -c "from dmlp_tpu.bench.configs import BENCH_CONFIGS; \
+	from dmlp_tpu.bench.harness import ensure_input; \
+	ensure_input(BENCH_CONFIGS[1], 'inputs')"
+	rm -f outputs/obs_metrics.jsonl
+	JAX_PLATFORMS=cpu python -m dmlp_tpu --trace outputs/obs_trace.json \
+	  --metrics outputs/obs_metrics.jsonl < inputs/input1.in \
+	  > outputs/obs_smoke.out 2> outputs/obs_smoke.err
+	grep -q "Time taken:" outputs/obs_smoke.err
+	python tools/check_trace.py outputs/obs_trace.json outputs/obs_metrics.jsonl
 
 clean:
 	rm -f native/_fastparse.so

@@ -306,11 +306,17 @@ def main() -> int:
         "path": path,
     }
     # MEASURED reference-binary comparison, when a capture exists for this
-    # exact shape (tools/capture_oracle.sh; bench_4's config IS the bench
-    # workload spec). This is the real thing the estimated ratio above is
-    # not: the reference's own stripped engine, run in THIS container via
-    # isolated-singleton Open MPI, checksum-parity-verified against this
-    # framework (oracle_capture/ORACLE_GOLDEN.json, tools/oracle_diff.py).
+    # shape (tools/capture_oracle.sh; bench_4's 200k x 10k x 64 config).
+    # NOT an exact workload match: input3's per-query k is uniform in
+    # [1, 32] while this bench fixes k=32 for EVERY query, so the engine
+    # side solves the strictly harder workload and the multiple below is
+    # conservative (ADVICE r5). The harness config 1-4 path compares
+    # sha256-pinned identical inputs; this one trades that exactness for
+    # a same-shape annotation. Still the real thing the estimated ratio
+    # above is not: the reference's own stripped engine, run in THIS
+    # container via isolated-singleton Open MPI, checksum-parity-verified
+    # against this framework (oracle_capture/ORACLE_GOLDEN.json,
+    # tools/oracle_diff.py).
     if (num_data, num_queries, num_attrs, k) == (200_000, 10_000, 64, 32):
         from dmlp_tpu.bench.harness import reference_binary_fields
         out.update(reference_binary_fields(

@@ -150,7 +150,8 @@ def _config_env(cfg: BenchConfig, env: Optional[dict]) -> Optional[dict]:
 def run_engine(cfg: BenchConfig, input_path: str, outputs_dir: str,
                mode: Optional[str] = None, fast: bool = False,
                warmup: bool = True, timeout_s: float = 300.0,
-               env: Optional[dict] = None) -> tuple[str, str]:
+               env: Optional[dict] = None,
+               obs_flags: Optional[list] = None) -> tuple[str, str]:
     """Run the engine CLI as a subprocess over a real pipe, under a kill
     timeout; returns (tmp.out, tmp.err) paths.
 
@@ -161,6 +162,8 @@ def run_engine(cfg: BenchConfig, input_path: str, outputs_dir: str,
     reference's oracle diff; ``fast=True`` drops the host rescore for
     pure-device timing at the cost of f32 ordering. ``cfg.mesh_shape``
     (run_bench.sh's task-count analog) is passed through as ``--mesh``.
+    ``obs_flags`` (e.g. ``["--trace", path]``) ride through to the engine
+    CLI — the per-config observability capture.
     """
     import subprocess
     import sys
@@ -171,6 +174,8 @@ def run_engine(cfg: BenchConfig, input_path: str, outputs_dir: str,
         argv.append("--fast")
     if warmup:
         argv.append("--warmup")
+    if obs_flags:
+        argv += list(obs_flags)
     env = _config_env(cfg, env)
     with open(input_path, "rb") as stdin:
         proc = subprocess.Popen(argv, stdin=stdin, stdout=subprocess.PIPE,
@@ -273,7 +278,9 @@ def run_config(config_id: int, base_dir: str = ".",
                mode: Optional[str] = None, fast: bool = False,
                force_oracle: bool = False, out: Optional[TextIO] = None,
                timeout_s: float = 300.0, env: Optional[dict] = None,
-               reps: int = 1) -> dict:
+               reps: int = 1, trace_dir: Optional[str] = None,
+               counters: bool = False,
+               record_path: Optional[str] = None) -> dict:
     """Full benchmark flow for one config; returns a result summary dict.
 
     ``reps`` > 1 runs the engine subprocess that many times and reports
@@ -284,6 +291,15 @@ def run_config(config_id: int, base_dir: str = ".",
     swings up to 30x within minutes (BENCH_MODES_r04.json), so a
     single-shot engine time measures weather, not the engine. Deviation
     documented here and visible in the artifact.
+
+    Observability (dmlp_tpu.obs): ``trace_dir`` captures a per-config
+    Perfetto trace + metrics JSONL from the engine subprocess
+    (trace_configN.json / metrics_configN.jsonl; the LAST rep's trace
+    wins, every rep's metrics append); ``counters`` adds the engine's
+    stderr roofline summary; ``record_path`` appends one versioned
+    RunRecord per config — the schema replacing ad-hoc BENCH_*.json.
+    Single-process configs only (a multi-process cluster would collide
+    on the artifact files).
     """
     import sys
 
@@ -291,6 +307,21 @@ def run_config(config_id: int, base_dir: str = ".",
     cfg = BENCH_CONFIGS[config_id]
     inputs_dir = os.path.join(base_dir, "inputs")
     outputs_dir = os.path.join(base_dir, "outputs")
+
+    obs_flags: list = []
+    if counters:
+        obs_flags.append("--counters")
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        obs_flags += ["--trace",
+                      os.path.join(trace_dir, f"trace_config{config_id}.json"),
+                      "--metrics",
+                      os.path.join(trace_dir,
+                                   f"metrics_config{config_id}.jsonl")]
+    if obs_flags and cfg.procs > 1:
+        out.write(f"Config {config_id}: note — observability capture "
+                  "applies to single-process configs only; skipping\n")
+        obs_flags = []
 
     input_path = ensure_input(cfg, inputs_dir)
     oracle_out, oracle_err = ensure_oracle(cfg, input_path, outputs_dir, out,
@@ -316,7 +347,7 @@ def run_config(config_id: int, base_dir: str = ".",
             else:
                 engine_out, engine_err = run_engine(
                     cfg, input_path, outputs_dir, mode=mode, fast=fast,
-                    timeout_s=timeout_s, env=env)
+                    timeout_s=timeout_s, env=env, obs_flags=obs_flags)
         except (EngineTimeout, RuntimeError) as e:
             if got is not None:
                 # Later-rep flake on the swinging link: keep the earlier
@@ -333,6 +364,8 @@ def run_config(config_id: int, base_dir: str = ".",
                    "percent_vs_oracle": None}
             res["timeout" if kind == "TIMEOUT" else "error"] = \
                 True if kind == "TIMEOUT" else str(e)
+            if record_path:
+                _append_run_record(record_path, cfg, res, trace_dir)
             return res
         with open(engine_out) as f:
             got_r = f.read()
@@ -380,7 +413,37 @@ def run_config(config_id: int, base_dir: str = ".",
         res.update(reference_binary_fields(
             os.path.join(base_dir, "oracle_capture", "ORACLE_GOLDEN.json"),
             config_id, res["engine_ms"]))
+    if record_path:
+        _append_run_record(record_path, cfg, res, trace_dir)
     return res
+
+
+def _append_run_record(record_path: str, cfg: BenchConfig, res: dict,
+                       trace_dir: Optional[str]) -> None:
+    """One versioned RunRecord per config run (obs.run) — the uniform
+    artifact new bench emitters share instead of private BENCH_* shapes."""
+    import dataclasses
+
+    from dmlp_tpu.obs.run import RunRecord
+
+    artifacts = {}
+    failed = bool(res.get("timeout") or res.get("error"))
+    if trace_dir and cfg.procs == 1 and not failed:
+        # Only paths that actually exist, and only for completed runs: a
+        # timed-out/killed engine never wrote its trace, and a RunRecord
+        # pointing at a missing (or stale earlier-rep) file would
+        # mislead every consumer.
+        candidates = {
+            "trace": os.path.join(
+                trace_dir, f"trace_config{cfg.config_id}.json"),
+            "metrics": os.path.join(
+                trace_dir, f"metrics_config{cfg.config_id}.jsonl"),
+        }
+        artifacts = {k: p for k, p in candidates.items()
+                     if os.path.exists(p)}
+    RunRecord(kind="bench", tool="dmlp_tpu.bench",
+              config=dataclasses.asdict(cfg), metrics=dict(res),
+              artifacts=artifacts).append_jsonl(record_path)
 
 
 def reference_binary_fields(cap_path: str, config_id: int,
@@ -429,6 +492,16 @@ def main(argv=None) -> int:
                    help="engine runs per config; >1 reports the median "
                         "(de-weathers the tunneled link; the reference "
                         "protocol is single-shot)")
+    p.add_argument("--trace", metavar="DIR", default=None, dest="trace_dir",
+                   help="per-config observability capture: the engine "
+                        "subprocess writes DIR/trace_configN.json "
+                        "(Perfetto) + DIR/metrics_configN.jsonl")
+    p.add_argument("--metrics", metavar="FILE", default=None,
+                   help="append one versioned RunRecord (obs.run) per "
+                        "config to FILE — the uniform bench artifact")
+    p.add_argument("--counters", action="store_true",
+                   help="engine subprocesses print XLA cost-analysis + "
+                        "roofline summaries on stderr")
     args = p.parse_args(argv)
 
     ids = list(BENCH_CONFIGS) if args.config == "all" else [int(args.config)]
@@ -436,7 +509,9 @@ def main(argv=None) -> int:
     for cid in ids:
         res = run_config(cid, base_dir=args.base_dir, mode=args.mode,
                          fast=args.fast, force_oracle=args.force_oracle,
-                         timeout_s=args.timeout, reps=args.reps)
+                         timeout_s=args.timeout, reps=args.reps,
+                         trace_dir=args.trace_dir, counters=args.counters,
+                         record_path=args.metrics)
         ok = ok and res["checksums_match"]
     return 0 if ok else 1
 

@@ -6,10 +6,18 @@ common.cpp:81-135 + run_bench.sh:82-84): stdout carries per-query results
 ``Time taken: <ms> ms`` contract line. No mpirun: one process drives the
 device mesh.
 
+Observability (dmlp_tpu.obs) is opt-in and leaves both contract channels
+byte-identical: ``--trace FILE`` writes a Perfetto-loadable span trace,
+``--metrics FILE`` appends JSONL records whose final summary carries XLA
+cost-analysis counters (or an explicit ``counters_unavailable`` marker)
+and collective-traffic accounting; ``--counters`` prints a roofline
+summary to stderr after the contract line.
+
 Usage::
 
     python -m dmlp_tpu [--mode single|sharded|ring] [--debug] [--fast]
-                       [--engine jax|golden] [--phase-times] < input.in
+                       [--engine jax|golden] [--phase-times]
+                       [--trace FILE] [--metrics FILE] [--counters] < input.in
 """
 
 from __future__ import annotations
@@ -22,6 +30,7 @@ from typing import IO, Optional, Sequence
 from dmlp_tpu.config import EngineConfig
 from dmlp_tpu.io.grammar import parse_input
 from dmlp_tpu.io.report import format_results
+from dmlp_tpu.obs.trace import span as obs_span
 from dmlp_tpu.utils.timing import EngineTimer
 
 
@@ -69,6 +78,58 @@ def make_engine(config: EngineConfig, stderr=None):
     raise ValueError(f"unknown mode {config.mode!r}")
 
 
+def _emit_metrics(path: str, args, inp, timer: EngineTimer, phase_ms: dict,
+                  counters: Optional[dict], comms: Optional[dict]) -> None:
+    """Append per-phase records + one run summary to the metrics JSONL.
+
+    The summary is the contract record: it always carries a ``counters``
+    block — either cost-analysis flops/bytes or the explicit
+    ``counters_unavailable`` marker — never silence."""
+    from dmlp_tpu.obs.run import SCHEMA_VERSION
+    from dmlp_tpu.utils.metrics_log import MetricsLogger
+
+    with MetricsLogger(path=path) as mlog:
+        for name, ms in phase_ms.items():
+            mlog.log(event="phase", name=name, ms=round(ms, 3))
+        summary = {
+            "event": "summary", "schema": SCHEMA_VERSION,
+            "mode": args.mode, "engine": args.engine,
+            "exact": not args.fast,
+            "elapsed_ms": round(timer.elapsed_ms, 3),
+            "num_data": inp.params.num_data,
+            "num_queries": inp.params.num_queries,
+            "num_attrs": inp.params.num_attrs,
+            "counters": counters if counters is not None
+            else {"counters_unavailable": True},
+        }
+        if comms is not None:
+            summary["comms"] = comms
+        mlog.log(**summary)
+
+
+def _emit_counters_stderr(counters: Optional[dict], elapsed_ms: float,
+                          stderr: IO) -> None:
+    """The --counters human summary (after the contract line)."""
+    if not counters or counters.get("counters_unavailable"):
+        stderr.write("counters: unavailable (no analyzable dispatches "
+                     "on this backend)\n")
+        return
+    from dmlp_tpu.obs.counters import roofline
+    stderr.write(f"counters: flops={counters['flops']:.4e} "
+                 f"hbm_bytes={counters['bytes_accessed']:.4e} "
+                 f"dispatches={counters['dispatches_recorded']}\n")
+    rl = roofline(counters["flops"], counters["bytes_accessed"],
+                  elapsed_ms / 1e3)
+    if "achieved_flops_per_s" in rl:
+        line = f"roofline: {rl['achieved_flops_per_s']:.4e} FLOP/s achieved"
+        if "utilization_vs_peak" in rl:
+            line += (f", {rl['utilization_vs_peak'] * 100:.3f}% of "
+                     f"{rl['peak_flops_per_chip']:.3g} peak")
+        if "arithmetic_intensity" in rl:
+            line += f", {rl['arithmetic_intensity']:.2f} FLOP/B"
+        stderr.write(line + "\n")
+
+
 def main(argv: Optional[Sequence[str]] = None,
          stdin: Optional[IO] = None,
          stdout: Optional[IO] = None,
@@ -108,6 +169,18 @@ def main(argv: Optional[Sequence[str]] = None,
     parser.add_argument("--profile", metavar="DIR", default=None,
                         help="write a jax.profiler trace of the solve to "
                              "DIR (survey §5.1 observability gap)")
+    parser.add_argument("--trace", metavar="FILE", default=None,
+                        help="write a Perfetto/Chrome-trace JSON of the "
+                             "run's phase spans to FILE (obs.trace; load "
+                             "at ui.perfetto.dev)")
+    parser.add_argument("--metrics", metavar="FILE", default=None,
+                        help="append JSONL metrics to FILE; the final "
+                             "summary record carries cost-analysis "
+                             "counters and collective-traffic accounting")
+    parser.add_argument("--counters", action="store_true",
+                        help="print an XLA cost-analysis + roofline "
+                             "summary to stderr (extension; implies "
+                             "counter collection)")
     parser.add_argument("--warmup", action="store_true",
                         help="run the solve once untimed first, so the "
                              "timed region excludes XLA compilation (the "
@@ -118,6 +191,26 @@ def main(argv: Optional[Sequence[str]] = None,
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
 
+    tracer = probe = None
+    if args.trace:
+        from dmlp_tpu.obs import trace as obs_trace
+        tracer = obs_trace.install(
+            obs_trace.Tracer(annotate=bool(args.profile)))
+    if args.metrics or args.counters:
+        from dmlp_tpu.obs import counters as obs_counters
+        probe = obs_counters.install()
+    try:
+        return _run_cli(parser, args, stdin, stdout, stderr, tracer, probe)
+    finally:
+        if tracer is not None:
+            from dmlp_tpu.obs import trace as obs_trace
+            obs_trace.uninstall()
+        if probe is not None:
+            from dmlp_tpu.obs import counters as obs_counters
+            obs_counters.uninstall()
+
+
+def _run_cli(parser, args, stdin, stdout, stderr, tracer, probe) -> int:
     mesh_shape = parse_mesh_arg(parser, args.mesh)
     config = EngineConfig(mode=args.mode, debug=args.debug,
                           exact=not args.fast, data_block=args.data_block,
@@ -126,30 +219,41 @@ def main(argv: Optional[Sequence[str]] = None,
                           mesh_shape=mesh_shape)
 
     timer = EngineTimer()
-    with timer.phase("parse"):
+    with timer.phase("parse"), obs_span("cli.parse"):
         inp = parse_input(stdin)
 
     # Only the solve is timed, matching the reference's timed region
     # (common.cpp:122-131 brackets Engine::KNN after ingest).
+    engine = None
     if args.engine == "golden":
         timer.start()
         from dmlp_tpu.golden.reference import knn_golden
-        results = knn_golden(inp)
+        with obs_span("cli.solve", engine="golden"):
+            results = knn_golden(inp)
     else:
         engine = make_engine(config, stderr=stderr)
         solve = engine.run_device_full if args.device_full else engine.run
         if args.warmup:
-            with timer.phase("warmup_compile"):
+            with timer.phase("warmup_compile"), \
+                    obs_span("cli.warmup_compile"):
                 solve(inp)
+            if probe is not None:
+                # The warmup solve recorded the same dispatches the timed
+                # solve is about to; without a reset every counter would
+                # double and the roofline (counters / timed elapsed)
+                # would overstate achieved FLOP/s ~2x.
+                probe.reset()
         import contextlib
         profile_cm = contextlib.nullcontext()
         if args.profile:
             import jax
             profile_cm = jax.profiler.trace(args.profile)
         timer.start()
-        with profile_cm:
+        with profile_cm, obs_span("cli.solve", mode=args.mode,
+                                  engine="jax"):
             results = solve(inp)
-    text = format_results(results, debug=config.debug)
+    with obs_span("cli.format_results"):
+        text = format_results(results, debug=config.debug)
     timer.stop()
 
     stdout.write(text)
@@ -157,6 +261,28 @@ def main(argv: Optional[Sequence[str]] = None,
     if args.phase_times:
         for name, ms in timer.phase_ms.items():
             stderr.write(f"phase {name}: {ms:.1f} ms\n")
+
+    # -- observability epilogue (outside the timed region; contract
+    # channels above are already written and stay byte-identical) --------
+    if probe is not None or tracer is not None:
+        phase_ms = dict(timer.phase_ms)
+        if engine is not None:
+            phase_ms.update(getattr(engine, "last_phase_ms", {}))
+        counters = None
+        if probe is not None:
+            with obs_span("cli.collect_counters"):
+                counters = probe.collect()
+        comms = None
+        if engine is not None and getattr(engine, "last_comms", None):
+            from dmlp_tpu.obs.comms import summarize
+            comms = summarize(engine.last_comms)
+        if args.metrics:
+            _emit_metrics(args.metrics, args, inp, timer, phase_ms,
+                          counters, comms)
+        if args.counters:
+            _emit_counters_stderr(counters, timer.elapsed_ms, stderr)
+        if tracer is not None:
+            tracer.write(args.trace)
     return 0
 
 

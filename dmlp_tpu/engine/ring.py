@@ -1,5 +1,13 @@
 """Ring-streaming engine (CLI registry home; implementation in sharded.py,
 which both mesh engines share — they differ only in the cross-shard merge:
-all-gather vs merge-top-k ring all-reduce)."""
+all-gather vs merge-top-k ring all-reduce).
+
+Observability rides the shared implementation too: the ring merge's
+``ppermute`` traffic is accounted per solve in ``engine.last_comms``
+(obs.comms.ring_topk_traffic — R-1 hops of the O(k) accumulator; same
+per-device wire bytes as the all-gather, O(k) instead of O(R*k) peak
+memory), and the phase spans / cost-counter hooks land under the same
+``sharded.*`` trace names.
+"""
 
 from dmlp_tpu.engine.sharded import RingEngine  # noqa: F401

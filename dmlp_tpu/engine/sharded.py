@@ -35,6 +35,9 @@ from dmlp_tpu.engine.single import (ChunkThrottle, fit_blocks, pad_dataset,
                                     resolve_kcap, round_up)
 from dmlp_tpu.io.grammar import KNNInput
 from dmlp_tpu.io.report import QueryResult
+from dmlp_tpu.obs import counters as obs_counters
+from dmlp_tpu.obs.comms import engine_comms
+from dmlp_tpu.obs.trace import span as obs_span
 from dmlp_tpu.ops.topk import TopK, streaming_topk
 from dmlp_tpu.parallel.collectives import allgather_merge_topk, ring_allreduce_topk
 from dmlp_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS, make_mesh
@@ -89,6 +92,7 @@ class ShardedEngine:
         self._fns: Dict[Tuple, object] = {}  # compiled-program cache
         self.last_phase_ms: Dict[str, float] = {}
         self.last_hetk = None  # (bulk, outlier) counts when routing split
+        self.last_comms: list = []  # obs.comms traffic of the last solve
 
     def _np_dtype(self):
         """Wire dtype from the engine's (possibly no_auto_coarsen-swapped)
@@ -99,7 +103,9 @@ class ShardedEngine:
     def _shard_inputs(self, inp: KNNInput, data_block: int, qgran: int = 8):
         import time as _time
         t0 = _time.perf_counter()
-        out = self._shard_inputs_inner(inp, data_block, qgran)
+        with obs_span("sharded.stage_enqueue",
+                      mesh=list(self.mesh.devices.shape)):
+            out = self._shard_inputs_inner(inp, data_block, qgran)
         # Host-side staging enqueue (pad + convert + async device_put) —
         # transfer wait lands in "fetch" like the other enqueue phases.
         self.last_phase_ms["stage_enqueue"] = \
@@ -455,32 +461,51 @@ class ShardedEngine:
 
         src = np.ascontiguousarray(inp.data_attrs, np.float32)
         throttle = ChunkThrottle()
-        for t in range(nchunks):
-            toff = t * chunk_rows
-            # Staging buffer directly in the wire dtype: slice assignment
-            # converts in place (one pass), instead of f32-zeros + a full
-            # astype copy per chunk.
-            a = np.zeros((r * chunk_rows, na), np_dtype)
-            for rr in range(r):
-                lo = rr * shard_rows + toff
-                # Cap at the shard boundary too (see _chunk_fold_fn): the
-                # rows past it belong to — and are staged by — shard rr+1.
-                hi = min(lo + chunk_rows, (rr + 1) * shard_rows, n)
-                if hi > lo:
-                    a[rr * chunk_rows: rr * chunk_rows + (hi - lo)] = \
-                        src[lo:hi]
-            a_dev = jax.device_put(a, csh)
-            sc = jax.device_put(
-                np.asarray([n, toff, shard_rows], np.int32), rsh)
-            cd, ci = step(cd, ci, a_dev, q_dev, sc)
-            if ostep is not None:
-                od, ol, oi = ostep(od, ol, oi, a_dev, qo_dev, lab_dev, sc)
-            throttle.tick(od if ostep is not None else cd)
+        with obs_span("sharded.enqueue_chunked", chunks=nchunks,
+                      mesh=[r, c], kc=k):
+            for t in range(nchunks):
+                toff = t * chunk_rows
+                # Staging buffer directly in the wire dtype: slice
+                # assignment converts in place (one pass), instead of
+                # f32-zeros + a full astype copy per chunk.
+                a = np.zeros((r * chunk_rows, na), np_dtype)
+                for rr in range(r):
+                    lo = rr * shard_rows + toff
+                    # Cap at the shard boundary too (see _chunk_fold_fn):
+                    # the rows past it belong to — and are staged by —
+                    # shard rr+1.
+                    hi = min(lo + chunk_rows, (rr + 1) * shard_rows, n)
+                    if hi > lo:
+                        a[rr * chunk_rows: rr * chunk_rows + (hi - lo)] = \
+                            src[lo:hi]
+                a_dev = jax.device_put(a, csh)
+                sc = jax.device_put(
+                    np.asarray([n, toff, shard_rows], np.int32), rsh)
+                if t == 0:
+                    obs_counters.record_dispatch(
+                        step, (cd, ci, a_dev, q_dev, sc), count=nchunks,
+                        site="sharded.chunk_fold")
+                cd, ci = step(cd, ci, a_dev, q_dev, sc)
+                if ostep is not None:
+                    od, ol, oi = ostep(od, ol, oi, a_dev, qo_dev, lab_dev,
+                                       sc)
+                throttle.tick(od if ostep is not None else cd)
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
-        top_b = self._chunk_merge_fn(k)(cd, ci, lab_dev)
+        # Collective-traffic accounting from the shapes actually merged
+        # (obs.comms): one cross-shard merge per query-axis column.
+        self.last_comms = engine_comms(self._merge_strategy, (r, c),
+                                       qpad // c, k)
+        merge_fn = self._chunk_merge_fn(k)
+        obs_counters.record_dispatch(merge_fn, (cd, ci, lab_dev),
+                                     site="sharded.chunk_merge")
+        with obs_span("sharded.merge", mesh=[r, c], kc=k) as sp:
+            top_b = merge_fn(cd, ci, lab_dev)
+            sp.fence(top_b.dists)
         if split is None:
             return top_b, qpad
+        self.last_comms = self.last_comms + engine_comms(
+            self._merge_strategy, (r, c), qo_pad // c, ko)
         top_o = self._outlier_merge_fn(ko)(od, ol, oi)
         return [(top_b, qpad, bulk_idx, "extract"),
                 (top_o, qo_pad, out_idx, select_out)]
@@ -495,6 +520,7 @@ class ShardedEngine:
         nq = inp.params.num_queries
         self.last_phase_ms = {}  # no stale phases if a path is skipped
         self.last_hetk = None    # routed=False below: no split ever fires
+        self.last_comms = []     # no stale traffic either
         out = self._solve_chunked_extract(inp, routed=False)
         if out is not None:
             top, _ = out
@@ -503,11 +529,28 @@ class ShardedEngine:
             d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(
                 inp, data_block, qgran)
             self._last_select = select  # run() gates the tie-overflow repair
-            top = self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
-                                                  q_attrs)
+            top = self._solve_merged(k, data_block, select, d_attrs,
+                                     d_labels, d_ids, q_attrs)
         return (np.asarray(top.dists, np.float64)[:nq],
                 np.asarray(top.labels)[:nq],
                 np.asarray(top.ids)[:nq])
+
+    def _solve_merged(self, k: int, data_block: int, select: str,
+                      d_attrs, d_labels, d_ids, q_attrs):
+        """Dispatch the monolithic merged program, with obs hooks: the
+        dispatch is recorded for cost-analysis counters and the merge's
+        collective traffic is accounted from the dispatched shapes."""
+        fn = self._fn(k, data_block, select)
+        args = (d_attrs, d_labels, d_ids, q_attrs)
+        obs_counters.record_dispatch(fn, args, site="sharded.solve_merge")
+        r, c = self.mesh.devices.shape
+        self.last_comms = engine_comms(self._merge_strategy, (r, c),
+                                       q_attrs.shape[0] // c, k)
+        with obs_span("sharded.solve_merge", select=select, mesh=[r, c],
+                      kcap=k) as sp:
+            top = fn(*args)
+            sp.fence(top.dists)
+        return top
 
     def _solve_segments(self, inp: KNNInput):
         """Solve as (TopK, qpad, query_idx | None, select) segments — the
@@ -516,6 +559,7 @@ class ShardedEngine:
         the extraction kernel's bulk."""
         self.last_hetk = None
         self.last_phase_ms = {}
+        self.last_comms = []
         out = self._solve_chunked_extract(inp)
         if isinstance(out, list):
             return out
@@ -526,8 +570,8 @@ class ShardedEngine:
         d_attrs, d_labels, d_ids, q_attrs = self._shard_inputs(
             inp, data_block, qgran)
         self._last_select = select
-        top = self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
-                                              q_attrs)
+        top = self._solve_merged(k, data_block, select, d_attrs, d_labels,
+                                 d_ids, q_attrs)
         return [(top, q_attrs.shape[0], None, select)]
 
     def solve_global(self, d_attrs, d_labels, d_ids, q_attrs, kmax: int):
@@ -647,41 +691,47 @@ class ShardedEngine:
             # enqueued device work (staging + sharded solve + merge), not
             # just readback bytes.
             t0 = _time.perf_counter()
-            dists = np.asarray(top.dists, np.float64)[:nq]
-            labels = np.asarray(top.labels)[:nq]
-            ids = np.asarray(top.ids)[:nq]
+            with obs_span("sharded.fetch", select=select):
+                dists = np.asarray(top.dists, np.float64)[:nq]
+                labels = np.asarray(top.labels)[:nq]
+                ids = np.asarray(top.ids)[:nq]
             fetch_ms += (_time.perf_counter() - t0) * 1e3
             t0 = _time.perf_counter()
-            results = finalize_host(dists, labels, ids, sub.ks,
-                                    sub.query_attrs, sub.data_attrs,
-                                    exact=self.config.exact, query_ids=idx)
-            if select in ("sort", "topk", "seg", "extract") \
-                    and dists.shape[1] < n:
-                # Per-shard truncation surfaces on the merged lists: a
-                # point dropped by shard s has device dist > that shard's
-                # horizon, and the merged kcap-th <= any shard's kcap-th,
-                # so the same (eps-widened) boundary test covers both
-                # engines. width >= num_data means every real point is a
-                # candidate — nothing truncated. eps accounts for the
-                # staging dtype's non-monotone rounding
-                # (finalize.staging_eps; exact ties when f64-exact).
-                if dn_max is None:
-                    dn_max = float(np.einsum("na,na->n", inp.data_attrs,
-                                             inp.data_attrs).max())
-                qn = np.einsum("qa,qa->q", sub.query_attrs, sub.query_attrs)
-                eps = staging_eps(np.asarray(dists[:, -1], np.float64), qn,
-                                  dn_max, self._staging,
-                                  inp.params.num_attrs)
-                suspects = np.nonzero(
-                    boundary_overflow(dists, sub.ks, eps))[0]
-                if suspects.size:
-                    repair_boundary_overflow(results, suspects, sub)
-                    self.last_repairs += int(suspects.size)
-            if idx is None:
-                merged = results
-            else:
-                for local_i, orig in enumerate(idx):
-                    merged[int(orig)] = results[local_i]
+            with obs_span("sharded.finalize", exact=self.config.exact):
+                results = finalize_host(dists, labels, ids, sub.ks,
+                                        sub.query_attrs, sub.data_attrs,
+                                        exact=self.config.exact,
+                                        query_ids=idx)
+                if select in ("sort", "topk", "seg", "extract") \
+                        and dists.shape[1] < n:
+                    # Per-shard truncation surfaces on the merged lists:
+                    # a point dropped by shard s has device dist > that
+                    # shard's horizon, and the merged kcap-th <= any
+                    # shard's kcap-th, so the same (eps-widened) boundary
+                    # test covers both engines. width >= num_data means
+                    # every real point is a candidate — nothing
+                    # truncated. eps accounts for the staging dtype's
+                    # non-monotone rounding (finalize.staging_eps; exact
+                    # ties when f64-exact).
+                    if dn_max is None:
+                        dn_max = float(np.einsum(
+                            "na,na->n", inp.data_attrs,
+                            inp.data_attrs).max())
+                    qn = np.einsum("qa,qa->q", sub.query_attrs,
+                                   sub.query_attrs)
+                    eps = staging_eps(
+                        np.asarray(dists[:, -1], np.float64), qn, dn_max,
+                        self._staging, inp.params.num_attrs)
+                    suspects = np.nonzero(
+                        boundary_overflow(dists, sub.ks, eps))[0]
+                    if suspects.size:
+                        repair_boundary_overflow(results, suspects, sub)
+                        self.last_repairs += int(suspects.size)
+                if idx is None:
+                    merged = results
+                else:
+                    for local_i, orig in enumerate(idx):
+                        merged[int(orig)] = results[local_i]
             final_ms += (_time.perf_counter() - t0) * 1e3
         self.last_phase_ms["fetch"] = fetch_ms
         self.last_phase_ms["finalize"] = final_ms
@@ -744,6 +794,7 @@ class ShardedEngine:
 
         self.last_phase_ms = {}  # no stale phases if a path is skipped
         self.last_hetk = None
+        self.last_comms = []
         out = self._solve_chunked_extract(inp)
         if out is not None:
             from dmlp_tpu.engine.single import _device_epilogue
@@ -781,8 +832,17 @@ class ShardedEngine:
         ks_pad[:nq] = inp.ks
         ks_dev = jax.device_put(jnp.asarray(ks_pad), ksh)
 
-        p, i, d = self._fn_full(k, data_block, select, num_labels)(
-            d_attrs, d_labels, d_ids, q_attrs, ks_dev)
+        fn_full = self._fn_full(k, data_block, select, num_labels)
+        full_args = (d_attrs, d_labels, d_ids, q_attrs, ks_dev)
+        obs_counters.record_dispatch(fn_full, full_args,
+                                     site="sharded.device_full")
+        r, c = self.mesh.devices.shape
+        self.last_comms = engine_comms(self._merge_strategy, (r, c),
+                                       qpad // c, k)
+        with obs_span("sharded.device_full", select=select,
+                      mesh=[r, c]) as sp:
+            p, i, d = fn_full(*full_args)
+            sp.fence(d)
         preds = np.asarray(p)[:nq]
         rids = np.asarray(i)[:nq]
         rd = np.asarray(d, np.float64)[:nq]

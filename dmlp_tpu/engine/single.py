@@ -32,6 +32,8 @@ from dmlp_tpu.engine.finalize import (boundary_hazard, finalize_host,
                                       repair_boundary_overflow, staging_eps)
 from dmlp_tpu.io.grammar import KNNInput, subset_queries
 from dmlp_tpu.io.report import QueryResult
+from dmlp_tpu.obs import counters as obs_counters
+from dmlp_tpu.obs.trace import span as obs_span
 from dmlp_tpu.ops.topk import TopK, init_topk, make_block_step, streaming_topk
 from dmlp_tpu.ops.vote import majority_vote, report_order
 
@@ -399,9 +401,16 @@ class SingleChipEngine:
         q_blocks = jnp.asarray(
             q_attrs.reshape(qpad // qb, qb, -1), self._dtype)
 
-        out: TopK = _topk_blocks(d_attrs, d_labels, d_ids, q_blocks,
-                                 k=k, data_block=data_block, select=select,
-                                 use_pallas=cfg.use_pallas)
+        statics = dict(k=k, data_block=data_block, select=select,
+                       use_pallas=cfg.use_pallas)
+        obs_counters.record_dispatch(
+            _topk_blocks, (d_attrs, d_labels, d_ids, q_blocks),
+            statics=statics, site="single.topk_blocks")
+        with obs_span("single.solve_scan", select=select,
+                      qpad=qpad) as sp:
+            out: TopK = _topk_blocks(d_attrs, d_labels, d_ids, q_blocks,
+                                     **statics)
+            sp.fence(out.dists)
         return TopK(out.dists.reshape(qpad, -1), out.labels.reshape(qpad, -1),
                     out.ids.reshape(qpad, -1)), qpad
 
@@ -456,22 +465,29 @@ class SingleChipEngine:
         carries = [init_topk(qsb, k) for _ in range(nqb)]
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
         throttle = ChunkThrottle()
-        for c in range(nchunks):
-            lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
-            a = np.zeros((chunk_rows, na), np.float32)
-            lab = np.full(chunk_rows, -1, np.int32)
-            ids = np.full(chunk_rows, -1, np.int32)
-            if hi > lo:
-                a[:hi - lo] = src_attrs[lo:hi]
-                lab[:hi - lo] = inp.labels[lo:hi]
-                ids[:hi - lo] = np.arange(lo, hi, dtype=np.int32)
-            da = jnp.asarray(a, self._dtype)
-            dl, di = jnp.asarray(lab), jnp.asarray(ids)
-            for b in range(nqb):
-                carries[b] = _chunk_fold(carries[b], q_dev[b], da, dl, di,
-                                         k=k, select=select,
-                                         use_pallas=cfg.use_pallas)
-            throttle.tick(carries[-1].dists)
+        statics = dict(k=k, select=select, use_pallas=cfg.use_pallas)
+        with obs_span("single.enqueue_pipelined", select=select,
+                      chunks=nchunks, qblocks=nqb, k=k):
+            for c in range(nchunks):
+                lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
+                a = np.zeros((chunk_rows, na), np.float32)
+                lab = np.full(chunk_rows, -1, np.int32)
+                ids = np.full(chunk_rows, -1, np.int32)
+                if hi > lo:
+                    a[:hi - lo] = src_attrs[lo:hi]
+                    lab[:hi - lo] = inp.labels[lo:hi]
+                    ids[:hi - lo] = np.arange(lo, hi, dtype=np.int32)
+                da = jnp.asarray(a, self._dtype)
+                dl, di = jnp.asarray(lab), jnp.asarray(ids)
+                if c == 0:
+                    obs_counters.record_dispatch(
+                        _chunk_fold, (carries[0], q_dev[0], da, dl, di),
+                        statics=statics, count=nchunks * nqb,
+                        site="single.chunk_fold")
+                for b in range(nqb):
+                    carries[b] = _chunk_fold(carries[b], q_dev[b], da, dl,
+                                             di, **statics)
+                throttle.tick(carries[-1].dists)
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         if nqb == 1:
@@ -524,18 +540,19 @@ class SingleChipEngine:
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
         od = oi = None
         throttle = ChunkThrottle()
-        for c in range(nchunks):
-            lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
-            if lo >= n:
-                break  # whole-block padding can leave an empty last chunk
-            a = np.zeros((chunk_rows, na), np.float32)
-            if hi > lo:
-                a[:hi - lo] = src_attrs[lo:hi]
-            da = jnp.asarray(a, self._dtype)
-            od, oi, _iters = extract_topk(
-                q_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=k,
-                interpret=interpret)
-            throttle.tick(od)
+        with obs_span("single.enqueue_extract", chunks=nchunks, kc=k):
+            for c in range(nchunks):
+                lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
+                if lo >= n:
+                    break  # whole-block padding can leave an empty chunk
+                a = np.zeros((chunk_rows, na), np.float32)
+                if hi > lo:
+                    a[:hi - lo] = src_attrs[lo:hi]
+                da = jnp.asarray(a, self._dtype)
+                od, oi, _iters = extract_topk(
+                    q_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=k,
+                    interpret=interpret)
+                throttle.tick(od)
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         top = _extract_finalize(od, oi, jnp.asarray(inp.labels), k=k)
@@ -613,6 +630,23 @@ class SingleChipEngine:
         qpad = round_up(nq, QUERY_TILE)
         if not extract_supports(qpad, chunk_rows, na, kc):
             return None
+        # ADVICE r5 (single.py:614): passes 2+ dispatch extract_topk over
+        # the FULL concatenated d_full array, not chunk_rows — today the
+        # 128*ne divisibility and tile caps happen to carry from
+        # chunk_rows to its multiples, but supports() resolves its
+        # variant per row count and nothing guaranteed the carry-over.
+        # Assert the invariant the whole-array sweep actually needs, so
+        # future variant tuning fails loudly here instead of silently
+        # mis-tiling every pass after the first.
+        n_staged = min(nchunks, -(-n // chunk_rows))
+        full_rows = n_staged * chunk_rows
+        if not extract_supports(qpad, full_rows, na, kc):
+            raise AssertionError(
+                f"multi-pass extract: full-array sweep shape (qb={qpad}, "
+                f"rows={full_rows}, a={na}, kc={kc}) is untileable even "
+                f"though the per-chunk shape (rows={chunk_rows}) tiles — "
+                "extract_supports invariants diverged between the chunked "
+                "pass 1 and the resident passes 2+")
         interpret = not native_pallas_backend()
         self._last_select = "extract"
 
@@ -682,6 +716,9 @@ class SingleChipEngine:
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
         self.last_mp_passes = len(ods)
 
+        from dmlp_tpu.obs import trace as obs_trace
+        obs_trace.instant("single.multipass_sweep", passes=len(ods),
+                          kcap=kcap, chunks=n_staged)
         top, valid = _mp_merge(jnp.concatenate(ods, axis=1),
                                jnp.concatenate(ois, axis=1),
                                jnp.asarray(inp.labels), kcap=kcap)
@@ -852,6 +889,7 @@ class SingleChipEngine:
         n = inp.params.num_data
         segments = self._solve_segments(inp)
         self.last_repairs = 0  # tie-overflow repair rate, for bench records
+        self.last_comms = []   # one chip: no collectives (obs.comms)
         merged: List[QueryResult] = [None] * inp.params.num_queries
         # Max squared data-row norm (f64): scales the staging-dtype
         # perturbation bound of the hazard test — computed on first need
@@ -878,7 +916,8 @@ class SingleChipEngine:
             # as "readback costs X ms".
             fetch = ([] if self.config.exact else [top.dists]) + [top.ids] \
                 + ([cols_dev] if cols_dev is not None else [])
-            fetched = list(jax.device_get(fetch))
+            with obs_span("single.fetch", select=select, kcap=kcap):
+                fetched = list(jax.device_get(fetch))
             dists = None if self.config.exact \
                 else np.asarray(fetched.pop(0), np.float64)[:nq]
             ids = fetched.pop(0)[:nq]
@@ -904,14 +943,17 @@ class SingleChipEngine:
             fetch_ms += (_time.perf_counter() - t0) * 1e3
 
             t0 = _time.perf_counter()
-            results = finalize_host(dists, labels, ids, sub.ks,
-                                    sub.query_attrs, sub.data_attrs,
-                                    exact=self.config.exact, query_ids=idx)
-            if flags is not None:
-                suspects = np.nonzero(flags)[0]
-                if suspects.size:
-                    repair_boundary_overflow(results, suspects, sub)
-                    self.last_repairs += int(suspects.size)
+            with obs_span("single.finalize", exact=self.config.exact) as sp:
+                results = finalize_host(dists, labels, ids, sub.ks,
+                                        sub.query_attrs, sub.data_attrs,
+                                        exact=self.config.exact,
+                                        query_ids=idx)
+                if flags is not None:
+                    suspects = np.nonzero(flags)[0]
+                    if suspects.size:
+                        repair_boundary_overflow(results, suspects, sub)
+                        self.last_repairs += int(suspects.size)
+                        sp.set(repairs=int(suspects.size))
             if idx is None:
                 merged = results
             else:
@@ -932,6 +974,7 @@ class SingleChipEngine:
         """
         num_labels = int(inp.labels.max()) + 1 if inp.params.num_data else 1
         merged: List[QueryResult] = [None] * inp.params.num_queries
+        self.last_comms = []   # one chip: no collectives (obs.comms)
         with no_auto_coarsen(self):
             segments = self._solve_segments(inp, allow_multipass=False)
         for top, qpad, idx, _select in segments:

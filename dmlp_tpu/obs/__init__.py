@@ -1,0 +1,29 @@
+"""Unified observability: span traces, XLA cost counters, collective
+traffic accounting, and versioned run artifacts.
+
+The reference's entire observability surface is one ``Time taken: <ms> ms``
+stderr line (common.cpp:130). That contract line stays byte-identical
+(utils.timing); this package is everything on top of it, unified so the
+engines, the train loop, and the bench harness stop inventing private
+timing/metrics schemas:
+
+- :mod:`dmlp_tpu.obs.trace` — lightweight span tracer exporting
+  Chrome-trace / Perfetto-loadable JSON, with an optional bridge to
+  ``jax.profiler`` annotations on real TPUs.
+- :mod:`dmlp_tpu.obs.counters` — static per-dispatch FLOPs / HBM-bytes
+  counters from XLA's ``compiled.cost_analysis()``, with an
+  achieved-vs-peak roofline summary.
+- :mod:`dmlp_tpu.obs.comms` — analytic collective-traffic accounting
+  (bytes per mesh axis for the all-gather merge, the ring ``ppermute``
+  merge, grad ``psum``, and the MoE all-to-all).
+- :mod:`dmlp_tpu.obs.run` — the versioned :class:`RunRecord` artifact
+  writer all emitters share (replacing the divergent ``BENCH_*.json``
+  shapes going forward).
+
+Every module here is import-light: none of them import jax at module
+level, so the CLI's fast startup path is unaffected when observability is
+off, and the no-op span/probe hooks in the engine hot paths cost one
+module-global read each.
+"""
+
+from dmlp_tpu.obs.run import SCHEMA_VERSION, RunRecord  # noqa: F401

@@ -1,0 +1,188 @@
+"""Static per-dispatch counters from XLA cost analysis + roofline summary.
+
+XLA knows, at compile time, how many model FLOPs and HBM bytes each
+compiled program touches: ``jitted.lower(...).compile().cost_analysis()``.
+This module turns that into run-level counters without perturbing the hot
+path: engines *record* their dispatches into an installed
+:class:`CostProbe` (shapes only — arguments are reduced to
+``jax.ShapeDtypeStruct`` specs immediately, so no device buffer is kept
+alive), and the probe *collects* after the timed region by re-lowering
+each unique (function, shapes, statics) signature once and multiplying by
+its dispatch count.
+
+Cost analysis is best-effort across backends and program kinds (Pallas
+kernels, for one, typically expose no XLA cost model): every per-entry
+failure is swallowed and counted as ``skipped``; a collection where
+nothing was analyzable returns ``{"counters_unavailable": True}`` — the
+explicit marker the CLI metrics contract requires instead of silence.
+
+The roofline summary reuses the training side's per-chip peak table
+(train.metrics.PEAK_FLOPS_BY_KIND) so KNN solves and train steps report
+achieved-vs-peak on the same scale.
+
+Import-light: jax is imported lazily, only when a probe is actually used.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["CostProbe", "normalize_cost", "lowered_cost", "roofline",
+           "install", "uninstall", "active", "record_dispatch"]
+
+
+def normalize_cost(raw) -> Optional[Dict[str, float]]:
+    """Normalize ``cost_analysis()`` output across JAX versions: a dict,
+    a one-element list of dicts, or None. Returns {flops, bytes_accessed}
+    (floats; absent keys -> 0.0), or None when there is nothing usable."""
+    if raw is None:
+        return None
+    if isinstance(raw, (list, tuple)):
+        if not raw:
+            return None
+        raw = raw[0]
+    if not isinstance(raw, dict):
+        return None
+    flops = float(raw.get("flops", 0.0) or 0.0)
+    byts = float(raw.get("bytes accessed", 0.0) or 0.0)
+    if flops == 0.0 and byts == 0.0:
+        return None
+    return {"flops": flops, "bytes_accessed": byts}
+
+
+def lowered_cost(fn, *args, **kwargs) -> Optional[Dict[str, float]]:
+    """Cost analysis of one jitted signature; None when unavailable
+    (non-jitted callable, backend without a cost model, lowering error)."""
+    try:
+        return normalize_cost(fn.lower(*args, **kwargs).compile()
+                              .cost_analysis())
+    except Exception:
+        return None
+
+
+class CostProbe:
+    """Accumulates dispatch records (shape specs, not buffers) keyed by
+    signature; ``collect()`` resolves them into summed counters."""
+
+    def __init__(self) -> None:
+        # key -> [fn, spec_args, static_kwargs, count, site]
+        self._entries: Dict[Tuple, list] = {}
+
+    def reset(self) -> None:
+        """Drop recorded dispatches — callers bracket untimed work (e.g.
+        a warmup solve) so counters match the timed region only."""
+        self._entries.clear()
+
+    def record(self, fn, args: tuple, statics: Optional[dict] = None,
+               count: int = 1, site: str = "") -> None:
+        """Note ``count`` dispatches of ``fn(*args, **statics)``. ``args``
+        are reduced to ShapeDtypeStructs here — nothing stays alive."""
+        try:
+            import jax
+            specs = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
+        except Exception:
+            return  # non-array leaves etc. — observability must not raise
+        statics = dict(statics or {})
+        key = (id(fn), site,
+               str(jax.tree_util.tree_structure(specs)),
+               str(jax.tree_util.tree_leaves(specs)),
+               tuple(sorted((k, str(v)) for k, v in statics.items())))
+        entry = self._entries.get(key)
+        if entry is not None:
+            entry[3] += count
+        else:
+            self._entries[key] = [fn, specs, statics, count, site]
+
+    def collect(self) -> Dict[str, Any]:
+        """Resolve every recorded signature through cost analysis.
+
+        Returns summed ``flops`` / ``bytes_accessed`` with per-site
+        breakdown, or ``{"counters_unavailable": True, ...}`` when no
+        signature was analyzable (e.g. a backend with no cost model)."""
+        flops = byts = 0.0
+        analyzed = skipped = dispatches = 0
+        per_site: Dict[str, Dict[str, float]] = {}
+        for fn, specs, statics, count, site in self._entries.values():
+            dispatches += count
+            cost = lowered_cost(fn, *specs, **statics)
+            if cost is None:
+                skipped += count
+                continue
+            analyzed += count
+            flops += cost["flops"] * count
+            byts += cost["bytes_accessed"] * count
+            if site:
+                agg = per_site.setdefault(
+                    site, {"flops": 0.0, "bytes_accessed": 0.0,
+                           "dispatches": 0})
+                agg["flops"] += cost["flops"] * count
+                agg["bytes_accessed"] += cost["bytes_accessed"] * count
+                agg["dispatches"] += count
+        if analyzed == 0:
+            return {"counters_unavailable": True,
+                    "dispatches_recorded": dispatches}
+        out: Dict[str, Any] = {
+            "flops": flops, "bytes_accessed": byts,
+            "dispatches_recorded": dispatches,
+            "dispatches_analyzed": analyzed,
+        }
+        if skipped:
+            # No silent caps: name what the totals do NOT cover.
+            out["dispatches_skipped_no_cost_model"] = skipped
+        if per_site:
+            out["per_site"] = per_site
+        return out
+
+
+def roofline(flops: float, bytes_accessed: float, elapsed_s: float,
+             n_chips: int = 1) -> Dict[str, float]:
+    """Achieved-vs-peak summary for a solve that took ``elapsed_s``.
+
+    Peak comes from the training side's per-chip table
+    (train.metrics.peak_flops_per_chip), so 'utilization_vs_peak' is
+    directly comparable to the train loop's MFU. Conservative fallback
+    peak on unknown hardware, same as there."""
+    out = {"flops": flops, "bytes_accessed": bytes_accessed,
+           "elapsed_s": elapsed_s}
+    if elapsed_s > 0:
+        out["achieved_flops_per_s"] = flops / elapsed_s
+        out["achieved_bytes_per_s"] = bytes_accessed / elapsed_s
+    if bytes_accessed > 0:
+        out["arithmetic_intensity"] = flops / bytes_accessed
+    try:
+        from dmlp_tpu.train.metrics import peak_flops_per_chip
+        peak = peak_flops_per_chip()
+        out["peak_flops_per_chip"] = peak
+        if elapsed_s > 0 and peak > 0:
+            out["utilization_vs_peak"] = flops / (elapsed_s * n_chips * peak)
+    except Exception:
+        pass  # no backend / no devices: the static counters still stand
+    return out
+
+
+# -- process-wide hook (mirrors obs.trace) -----------------------------------
+_active: Optional[CostProbe] = None
+
+
+def install(probe: Optional[CostProbe] = None) -> CostProbe:
+    global _active
+    _active = probe if probe is not None else CostProbe()
+    return _active
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def active() -> Optional[CostProbe]:
+    return _active
+
+
+def record_dispatch(fn, args: tuple, statics: Optional[dict] = None,
+                    count: int = 1, site: str = "") -> None:
+    """Hot-path hook: records into the installed probe, no-op otherwise."""
+    p = _active
+    if p is not None:
+        p.record(fn, args, statics=statics, count=count, site=site)

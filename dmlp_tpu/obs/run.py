@@ -1,0 +1,102 @@
+"""Versioned run-artifact records — the one schema every emitter shares.
+
+Five rounds of benchmarking left ~15 ``tools/*.py`` scripts each inventing
+its own ``BENCH_*.json`` shape; nothing downstream can consume them
+uniformly. :class:`RunRecord` is the replacement going forward: a small
+versioned envelope (schema, tool, kind, host context) around free-form
+``config``/``metrics`` payloads plus the structured observability blocks
+(``counters`` from obs.counters, ``comms`` from obs.comms, ``artifacts``
+paths to trace files). Existing artifacts are grandfathered; new emitters
+write RunRecords (the bench harness and the engine CLI already do).
+
+Records serialize as strict JSON. ``write`` emits one record per file;
+``append_jsonl`` appends one record per line for multi-run logs — both
+atomic enough for the single-writer tooling here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import platform
+import time
+from typing import Any, Dict, Optional
+
+#: bump on any backward-incompatible field change; consumers key on this
+SCHEMA_VERSION = 1
+
+
+def _host_context() -> Dict[str, Any]:
+    ctx: Dict[str, Any] = {"python": platform.python_version()}
+    try:
+        import jax
+        ctx["jax"] = jax.__version__
+        # Touching jax.devices() would initialize a backend as a side
+        # effect (and can dial a remote TPU); record only what is free.
+    except Exception:
+        pass
+    return ctx
+
+
+@dataclasses.dataclass
+class RunRecord:
+    """One run's artifact: envelope + payload.
+
+    ``kind`` names the workload family ("engine", "bench", "train", ...);
+    ``tool`` names the emitter (e.g. "dmlp_tpu.cli", "dmlp_tpu.bench").
+    ``config`` holds the inputs that produced the run, ``metrics`` its
+    measurements; ``counters``/``comms``/``artifacts`` carry the obs
+    subsystem's structured blocks when present."""
+
+    kind: str
+    tool: str
+    config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    metrics: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    counters: Optional[Dict[str, Any]] = None
+    comms: Optional[Dict[str, Any]] = None
+    artifacts: Dict[str, str] = dataclasses.field(default_factory=dict)
+    schema: int = SCHEMA_VERSION
+    created_unix: float = dataclasses.field(default_factory=time.time)
+    host: Dict[str, Any] = dataclasses.field(default_factory=_host_context)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v not in (None, {})}
+
+    def to_json(self) -> str:
+        try:
+            return json.dumps(self.to_dict(), sort_keys=True)
+        except TypeError as e:
+            raise TypeError(
+                f"RunRecord for tool={self.tool!r} contains a "
+                f"non-JSON-serializable value: {e}") from None
+
+    def write(self, path: str) -> str:
+        """One record per file (atomic rename)."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_json() + "\n")
+        os.replace(tmp, path)
+        return path
+
+    def append_jsonl(self, path: str) -> str:
+        """One record per line, appended — the multi-run log form."""
+        line = self.to_json()
+        with open(path, "a") as f:
+            f.write(line + "\n")
+        return path
+
+    @staticmethod
+    def load(path: str) -> "RunRecord":
+        with open(path) as f:
+            return RunRecord.from_dict(json.loads(f.readline()))
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "RunRecord":
+        known = {f.name for f in dataclasses.fields(RunRecord)}
+        schema = d.get("schema")
+        if schema is not None and schema > SCHEMA_VERSION:
+            raise ValueError(f"RunRecord schema {schema} is newer than "
+                             f"this reader ({SCHEMA_VERSION})")
+        return RunRecord(**{k: v for k, v in d.items() if k in known})

@@ -1,0 +1,235 @@
+"""Lightweight span tracer exporting Chrome-trace / Perfetto JSON.
+
+One process-wide :class:`Tracer` (installed with :func:`install`) collects
+complete-duration events (``ph: "X"``) from ``with span("name"):`` blocks
+scattered through the engines, the CLI, and the train loop. When no tracer
+is installed every hook degenerates to a module-global read returning a
+shared no-op span — the hot paths pay nothing measurable (the <2%
+instrumentation-overhead budget is enforced by the obs-smoke bench).
+
+Device work is asynchronous under JAX, so a span that brackets only the
+*enqueue* of a dispatch would lie about where time goes. Spans therefore
+support explicit device fencing: ``sp.fence(arrays)`` makes the span's
+closing edge call ``jax.block_until_ready`` on those arrays, so the
+recorded duration covers the device work the block launched. Callers that
+already synchronize (``jax.device_get``, host readbacks) need no fence.
+
+Export is the Chrome trace-event JSON format — loadable directly in
+https://ui.perfetto.dev or chrome://tracing: ``ts``/``dur`` are
+microseconds from the tracer's epoch, nested ``X`` events on one thread
+render as a flame stack. On a real TPU the tracer can additionally mirror
+every span into ``jax.profiler`` annotations (``annotate=True``) so the
+same span names appear inside an XLA profiler capture
+(``jax.profiler.start_trace`` / ``--profile``).
+
+This module must stay import-light (no jax import at module level): the
+CLI imports it unconditionally.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_clock = time.perf_counter
+
+
+class _NullSpan:
+    """Shared no-op span: the uninstrumented fast path. Stateless, so one
+    singleton serves every (possibly nested, possibly concurrent) site."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **kwargs) -> None:
+        pass
+
+    def fence(self, value) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One traced region. Use as a context manager; ``set()`` attaches
+    args (rendered in the Perfetto detail pane), ``fence()`` registers
+    device values to ``block_until_ready`` before the closing timestamp."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0", "_fences", "_annot")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 args: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self.name = name
+        self.args = dict(args) if args else {}
+        self._t0 = 0.0
+        self._fences: list = []
+        self._annot = None
+
+    def set(self, **kwargs) -> None:
+        self.args.update(kwargs)
+
+    def fence(self, value) -> None:
+        self._fences.append(value)
+
+    def __enter__(self) -> "Span":
+        if self._tracer._annotate:
+            try:
+                from jax.profiler import TraceAnnotation
+                self._annot = TraceAnnotation(self.name)
+                self._annot.__enter__()
+            except Exception:
+                self._annot = None
+        self._t0 = _clock()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._fences:
+            try:
+                import jax
+                jax.block_until_ready(self._fences)
+            except Exception:
+                pass  # fencing is best-effort; the span still records
+            self._fences = []
+        t1 = _clock()
+        if self._annot is not None:
+            try:
+                self._annot.__exit__(*exc)
+            except Exception:
+                pass
+        self._tracer._complete(self.name, self._t0, t1, self.args)
+        return False
+
+
+class Tracer:
+    """Thread-safe collector of Chrome-trace events.
+
+    ``annotate=True`` mirrors spans into ``jax.profiler.TraceAnnotation``
+    so they show up inside an XLA profiler capture on real TPUs;
+    ``profile_dir`` additionally brackets the tracer's lifetime with
+    ``jax.profiler.start_trace``/``stop_trace`` (the heavyweight on-device
+    capture — span JSON stays available either way).
+    """
+
+    def __init__(self, annotate: bool = False,
+                 profile_dir: Optional[str] = None):
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._epoch = _clock()
+        self._pid = os.getpid()
+        self._tids: Dict[int, int] = {}
+        self._annotate = annotate
+        self._profile_dir = profile_dir
+        self._profiling = False
+        if profile_dir:
+            try:
+                import jax
+                jax.profiler.start_trace(profile_dir)
+                self._profiling = True
+            except Exception:
+                self._profiling = False
+
+    # -- recording -----------------------------------------------------------
+    def span(self, name: str, **args) -> Span:
+        return Span(self, name, args)
+
+    def instant(self, name: str, **args) -> None:
+        """A zero-duration marker (``ph: "i"``)."""
+        ts = (_clock() - self._epoch) * 1e6
+        self._append({"name": name, "ph": "i", "ts": ts, "s": "t",
+                      "pid": self._pid, "tid": self._tid(),
+                      **({"args": args} if args else {})})
+
+    def counter(self, name: str, **series) -> None:
+        """A counter sample (``ph: "C"``) — Perfetto renders a track."""
+        ts = (_clock() - self._epoch) * 1e6
+        self._append({"name": name, "ph": "C", "ts": ts, "pid": self._pid,
+                      "args": {k: float(v) for k, v in series.items()}})
+
+    def _complete(self, name: str, t0: float, t1: float,
+                  args: Dict[str, Any]) -> None:
+        ev = {"name": name, "ph": "X",
+              "ts": (t0 - self._epoch) * 1e6,
+              "dur": max((t1 - t0) * 1e6, 0.0),
+              "pid": self._pid, "tid": self._tid()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            return self._tids.setdefault(ident, len(self._tids))
+
+    def _append(self, ev: dict) -> None:
+        with self._lock:
+            self._events.append(ev)
+
+    # -- export --------------------------------------------------------------
+    def to_dict(self, process_name: str = "dmlp_tpu") -> dict:
+        meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
+                 "args": {"name": process_name}}]
+        with self._lock:
+            events = meta + list(self._events)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write(self, path: str, process_name: str = "dmlp_tpu") -> None:
+        if self._profiling:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._profiling = False
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(process_name), f)
+        os.replace(tmp, path)
+
+
+# -- process-wide hook -------------------------------------------------------
+_active: Optional[Tracer] = None
+
+
+def install(tracer: Tracer) -> Tracer:
+    """Make ``tracer`` the process-wide collector hooks report to."""
+    global _active
+    _active = tracer
+    return tracer
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def active() -> Optional[Tracer]:
+    return _active
+
+
+def span(name: str, **args):
+    """Instrumentation hook: a Span on the installed tracer, or the shared
+    no-op span when tracing is off (the common case; near-zero cost)."""
+    t = _active
+    return t.span(name, **args) if t is not None else NULL_SPAN
+
+
+def instant(name: str, **args) -> None:
+    t = _active
+    if t is not None:
+        t.instant(name, **args)
+
+
+def counter(name: str, **series) -> None:
+    t = _active
+    if t is not None:
+        t.counter(name, **series)

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dmlp_tpu.obs.trace import span as obs_span
 from dmlp_tpu.train import checkpoint as ckpt_lib
 from dmlp_tpu.train.data import teacher_batches
 from dmlp_tpu.train.metrics import throughput_metrics
@@ -244,15 +245,27 @@ def train(steps: int = 100, batch: int = 1024,
     data = prefetch_to_device(
         teacher_batches(d_in, n_classes, batch, seed=seed + 1), shardings)
 
+    # Analytic collective-traffic accounting for this run's mesh
+    # (obs.comms): the grad psum over dp, plus the MoE all-to-all when
+    # the a2a dispatch runs — logged once so per-step records stay small.
+    if metrics is not None:
+        comms = _train_comms(state, mesh, parallelism, dims, batch,
+                             moe_dispatch, capacity_factor, steps)
+        if comms is not None:
+            metrics.log(event="comms", **comms)
+
     last = {}
     t_window = time.perf_counter()
     window_steps = 0
     for i in range(start_step, start_step + steps):
         xd, yd = next(data)
-        state, m = step_fn(state, xd, yd)
+        with obs_span("train.step"):
+            state, m = step_fn(state, xd, yd)
         window_steps += 1
         if (i + 1) % log_every == 0 or i + 1 == start_step + steps:
-            m = jax.device_get(m)
+            with obs_span("train.log_window", step=i + 1) as sp:
+                m = jax.device_get(m)
+                sp.fence(state["params"])
             dt = (time.perf_counter() - t_window) / window_steps
             t_window = time.perf_counter()
             window_steps = 0
@@ -262,11 +275,35 @@ def train(steps: int = 100, batch: int = 1024,
             if metrics is not None:
                 metrics.log(**last)
         if checkpoint_dir and (i + 1) % ckpt_every == 0:
-            ckpt_lib.save_checkpoint(checkpoint_dir, state, step=i + 1)
+            with obs_span("train.checkpoint", step=i + 1):
+                ckpt_lib.save_checkpoint(checkpoint_dir, state, step=i + 1)
     if checkpoint_dir:
         ckpt_lib.save_checkpoint(checkpoint_dir, state,
                                  step=start_step + steps)
     return state, last
+
+
+def _train_comms(state, mesh, parallelism: str, dims, batch: int,
+                 moe_dispatch: str, capacity_factor: float,
+                 steps: int) -> Optional[dict]:
+    """obs.comms summary for this run's collective paths, from the real
+    mesh/param shapes; None when the run dispatches no collectives."""
+    import numpy as _np
+
+    from dmlp_tpu.obs import comms as obs_comms
+
+    param_bytes = int(sum(
+        _np.prod(x.shape) * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(state["params"])))
+    moe = None
+    if parallelism == "dp_ep" and moe_dispatch == "a2a":
+        from dmlp_tpu.train.experts import a2a_capacity
+        dp, ep = mesh.devices.shape
+        moe = {"ep": ep, "hidden": dims[1],
+               "capacity": a2a_capacity(batch, dp, ep, capacity_factor)}
+    traffic = obs_comms.train_step_comms(param_bytes, mesh.devices.shape,
+                                         steps=steps, moe=moe)
+    return obs_comms.summarize(traffic) if traffic else None
 
 
 def main(argv=None) -> int:
@@ -316,6 +353,9 @@ def main(argv=None) -> int:
     p.add_argument("--ckpt-every", type=int, default=100)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--metrics-file", default=None)
+    p.add_argument("--trace", metavar="FILE", default=None,
+                   help="write a Perfetto/Chrome-trace JSON of the run's "
+                        "step/checkpoint spans to FILE (obs.trace)")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--offload", nargs="?", const="all", default="none",
                    choices=["none", "params", "all"],
@@ -328,20 +368,33 @@ def main(argv=None) -> int:
     mesh_shape = None
     if args.mesh:
         mesh_shape = tuple(int(d) for d in args.mesh.split(","))
-    metrics = MetricsLogger(path=args.metrics_file) \
-        if args.metrics_file else MetricsLogger()
-    _, last = train(
-        steps=args.steps, batch=args.batch,
-        dims=tuple(int(d) for d in args.dims.split(",")),
-        mesh_shape=mesh_shape, optimizer_name=args.optimizer, lr=args.lr,
-        compute_dtype=args.compute_dtype, seed=args.seed,
-        checkpoint_dir=args.checkpoint_dir, ckpt_every=args.ckpt_every,
-        resume=args.resume, metrics=metrics, log_every=args.log_every,
-        offload=args.offload, parallelism=args.parallelism,
-        n_micro=args.microbatches, n_experts=args.experts,
-        moe_dispatch=args.moe_dispatch,
-        capacity_factor=args.capacity_factor,
-        pp_schedule=args.pp_schedule, n_virtual=args.virtual_stages)
+    tracer = None
+    if args.trace:
+        from dmlp_tpu.obs import trace as obs_trace
+        tracer = obs_trace.install(obs_trace.Tracer())
+    try:
+        mlog = (MetricsLogger(path=args.metrics_file)
+                if args.metrics_file else MetricsLogger())
+        with mlog as metrics:
+            _, last = train(
+                steps=args.steps, batch=args.batch,
+                dims=tuple(int(d) for d in args.dims.split(",")),
+                mesh_shape=mesh_shape, optimizer_name=args.optimizer,
+                lr=args.lr, compute_dtype=args.compute_dtype,
+                seed=args.seed, checkpoint_dir=args.checkpoint_dir,
+                ckpt_every=args.ckpt_every, resume=args.resume,
+                metrics=metrics, log_every=args.log_every,
+                offload=args.offload, parallelism=args.parallelism,
+                n_micro=args.microbatches, n_experts=args.experts,
+                moe_dispatch=args.moe_dispatch,
+                capacity_factor=args.capacity_factor,
+                pp_schedule=args.pp_schedule,
+                n_virtual=args.virtual_stages)
+    finally:
+        if tracer is not None:
+            from dmlp_tpu.obs import trace as obs_trace
+            tracer.write(args.trace)
+            obs_trace.uninstall()
     print(f"final: {last}")
     return 0
 

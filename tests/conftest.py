@@ -23,3 +23,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 from dmlp_tpu.utils.platform import honor_cpu_request  # noqa: E402
 
 honor_cpu_request()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests (tier-1 runs -m 'not slow')")

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Validate obs artifacts: a Perfetto span trace + a metrics JSONL.
+
+The `make obs-smoke` checker: loads the trace JSON the engine CLI wrote
+with ``--trace`` and the metrics JSONL from ``--metrics`` and asserts the
+structural contract the obs subsystem promises —
+
+- the trace is Chrome-trace JSON with a non-empty ``traceEvents`` list;
+- every complete event (``ph: "X"``) has a name, numeric ``ts``/``dur``
+  and ``pid``/``tid``;
+- the expected engine phase spans are present (a solve span at minimum);
+- every metrics line parses as JSON and carries the monotonic ``t_ms``;
+- the final metrics record is a summary whose ``counters`` block carries
+  either cost-analysis flops/bytes or the explicit
+  ``counters_unavailable`` marker — never silence.
+
+Exit 0 on success, 1 with a message naming the first violated invariant.
+
+Usage: python tools/check_trace.py TRACE.json METRICS.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def fail(msg: str) -> "None":
+    print(f"check_trace: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def check_trace(path: str) -> None:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        fail(f"trace {path} unreadable: {e}")
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        fail(f"trace {path}: traceEvents missing or empty")
+    spans = [e for e in events if e.get("ph") == "X"]
+    if not spans:
+        fail(f"trace {path}: no complete ('X') span events")
+    for e in spans:
+        for key in ("name", "ts", "dur", "pid", "tid"):
+            if key not in e:
+                fail(f"trace {path}: span {e} missing {key!r}")
+        if not isinstance(e["ts"], (int, float)) \
+                or not isinstance(e["dur"], (int, float)) or e["dur"] < 0:
+            fail(f"trace {path}: span {e['name']} has bad ts/dur")
+    names = {e["name"] for e in spans}
+    if not any(n.startswith("cli.solve") for n in names):
+        fail(f"trace {path}: no cli.solve span (got {sorted(names)})")
+    if not any(n.startswith(("single.", "sharded.")) for n in names):
+        fail(f"trace {path}: no engine phase spans (got {sorted(names)})")
+    print(f"check_trace: trace ok — {len(spans)} spans, "
+          f"{len(names)} distinct names")
+
+
+def check_metrics(path: str) -> None:
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError as e:
+        fail(f"metrics {path} unreadable: {e}")
+    if not lines:
+        fail(f"metrics {path}: empty")
+    records = []
+    for i, ln in enumerate(lines):
+        try:
+            records.append(json.loads(ln))
+        except json.JSONDecodeError as e:
+            fail(f"metrics {path}: line {i + 1} is not JSON: {e}")
+    for i, r in enumerate(records):
+        if "t_ms" not in r:
+            fail(f"metrics {path}: record {i + 1} missing monotonic t_ms")
+    final = records[-1]
+    if final.get("event") != "summary":
+        fail(f"metrics {path}: final record is not the run summary "
+             f"(got event={final.get('event')!r})")
+    counters = final.get("counters")
+    if not isinstance(counters, dict):
+        fail(f"metrics {path}: summary has no counters block")
+    if not counters.get("counters_unavailable") \
+            and not ("flops" in counters and "bytes_accessed" in counters):
+        fail(f"metrics {path}: counters block carries neither "
+             f"flops/bytes_accessed nor the counters_unavailable marker: "
+             f"{counters}")
+    print(f"check_trace: metrics ok — {len(records)} records, counters "
+          + ("unavailable (explicit)" if counters.get("counters_unavailable")
+             else f"flops={counters['flops']:.4g} "
+                  f"bytes={counters['bytes_accessed']:.4g}"))
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    check_trace(argv[0])
+    check_metrics(argv[1])
+    print("check_trace: all artifact invariants hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
