@@ -5,7 +5,7 @@
 CXX ?= g++
 CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
-.PHONY: all native test bench obs-smoke clean
+.PHONY: all native test bench obs-smoke obs-dist-smoke clean
 
 all: native
 
@@ -14,7 +14,7 @@ native: native/_fastparse.so
 native/_fastparse.so: native/fastparse.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
-test: obs-smoke
+test: obs-smoke obs-dist-smoke
 	python -m pytest tests/ -q
 
 # One-line JSON benchmark on the current backend (TPU under the default env).
@@ -36,6 +36,15 @@ obs-smoke:
 	  > outputs/obs_smoke.out 2> outputs/obs_smoke.err
 	grep -q "Time taken:" outputs/obs_smoke.err
 	python tools/check_trace.py outputs/obs_trace.json outputs/obs_metrics.jsonl
+
+# Distributed-observability smoke: a 2-process CPU cluster (emulated
+# ranks where the jax build lacks multi-process CPU computations) runs
+# the contract entry with per-rank tracing; tools/merge_traces.py merges
+# the rank files and tools/check_trace.py --dist validates the merged
+# Perfetto trace (distinct rank pids, clock-sync markers, monotonic
+# per-rank timestamps).
+obs-dist-smoke:
+	JAX_PLATFORMS=cpu python tools/obs_dist_smoke.py --dir outputs/dist_obs
 
 clean:
 	rm -f native/_fastparse.so

@@ -280,7 +280,8 @@ def run_config(config_id: int, base_dir: str = ".",
                timeout_s: float = 300.0, env: Optional[dict] = None,
                reps: int = 1, trace_dir: Optional[str] = None,
                counters: bool = False,
-               record_path: Optional[str] = None) -> dict:
+               record_path: Optional[str] = None,
+               profile_dir: Optional[str] = None) -> dict:
     """Full benchmark flow for one config; returns a result summary dict.
 
     ``reps`` > 1 runs the engine subprocess that many times and reports
@@ -300,6 +301,14 @@ def run_config(config_id: int, base_dir: str = ".",
     RunRecord per config — the schema replacing ad-hoc BENCH_*.json.
     Single-process configs only (a multi-process cluster would collide
     on the artifact files).
+
+    ``profile_dir`` requests a per-config on-device ``jax.profiler`` XLA
+    capture (the engine CLI's ``--profile``) into
+    ``profile_dir/profile_configN/``, linked from that config's
+    RunRecord artifacts. Real-TPU runs only: a config forced onto the
+    virtual-CPU platform (``cfg.virtual_devices``) or an environment
+    pinned to CPU records the explicit ``profile_unavailable`` marker
+    instead of a capture — never a silently absent artifact.
     """
     import sys
 
@@ -318,10 +327,28 @@ def run_config(config_id: int, base_dir: str = ".",
                       "--metrics",
                       os.path.join(trace_dir,
                                    f"metrics_config{config_id}.jsonl")]
+    profile: Optional[tuple] = None   # ("path", p) | ("unavailable", why)
+    if profile_dir:
+        cpu_pinned = bool(cfg.virtual_devices) or (
+            (env if env is not None else os.environ)
+            .get("JAX_PLATFORMS", "") == "cpu")
+        if cpu_pinned:
+            profile = ("unavailable", "cpu platform (virtual devices or "
+                       "JAX_PLATFORMS=cpu) — on-device XLA capture needs "
+                       "the real TPU")
+            out.write(f"Config {config_id}: note — --profile is a no-op "
+                      "on CPU; recording profile_unavailable\n")
+        else:
+            pdir = os.path.join(profile_dir, f"profile_config{config_id}")
+            os.makedirs(pdir, exist_ok=True)
+            obs_flags += ["--profile", pdir]
+            profile = ("path", pdir)
     if obs_flags and cfg.procs > 1:
         out.write(f"Config {config_id}: note — observability capture "
                   "applies to single-process configs only; skipping\n")
         obs_flags = []
+        if profile is not None and profile[0] == "path":
+            profile = ("unavailable", "multi-process config")
 
     input_path = ensure_input(cfg, inputs_dir)
     oracle_out, oracle_err = ensure_oracle(cfg, input_path, outputs_dir, out,
@@ -364,8 +391,19 @@ def run_config(config_id: int, base_dir: str = ".",
                    "percent_vs_oracle": None}
             res["timeout" if kind == "TIMEOUT" else "error"] = \
                 True if kind == "TIMEOUT" else str(e)
+            if profile is not None and profile[0] == "path":
+                # A killed/errored engine wrote no capture: record the
+                # explicit marker (never a silently absent artifact) and
+                # drop the pre-created empty capture dir.
+                try:
+                    os.rmdir(profile[1])
+                except OSError:
+                    pass
+                profile = ("unavailable",
+                           f"engine run failed ({kind.lower()})")
             if record_path:
-                _append_run_record(record_path, cfg, res, trace_dir)
+                _append_run_record(record_path, cfg, res, trace_dir,
+                                   profile=profile)
             return res
         with open(engine_out) as f:
             got_r = f.read()
@@ -413,20 +451,31 @@ def run_config(config_id: int, base_dir: str = ".",
         res.update(reference_binary_fields(
             os.path.join(base_dir, "oracle_capture", "ORACLE_GOLDEN.json"),
             config_id, res["engine_ms"]))
+    if profile is not None and profile[0] == "path" \
+            and not os.listdir(profile[1]):
+        # The engine accepted --profile but wrote nothing (e.g. the
+        # backend rejected the capture): an explicit marker, not a
+        # RunRecord pointing at an empty directory.
+        profile = ("unavailable", "engine wrote no capture")
     if record_path:
-        _append_run_record(record_path, cfg, res, trace_dir)
+        _append_run_record(record_path, cfg, res, trace_dir,
+                           profile=profile)
     return res
 
 
 def _append_run_record(record_path: str, cfg: BenchConfig, res: dict,
-                       trace_dir: Optional[str]) -> None:
+                       trace_dir: Optional[str],
+                       profile: Optional[tuple] = None) -> None:
     """One versioned RunRecord per config run (obs.run) — the uniform
-    artifact new bench emitters share instead of private BENCH_* shapes."""
+    artifact new bench emitters share instead of private BENCH_* shapes.
+    ``profile`` is ("path", dir) to link an on-device capture from the
+    artifacts block, or ("unavailable", why) for the explicit marker."""
     import dataclasses
 
     from dmlp_tpu.obs.run import RunRecord
 
     artifacts = {}
+    metrics = dict(res)
     failed = bool(res.get("timeout") or res.get("error"))
     if trace_dir and cfg.procs == 1 and not failed:
         # Only paths that actually exist, and only for completed runs: a
@@ -441,8 +490,13 @@ def _append_run_record(record_path: str, cfg: BenchConfig, res: dict,
         }
         artifacts = {k: p for k, p in candidates.items()
                      if os.path.exists(p)}
+    if profile is not None:
+        if profile[0] == "path" and not failed:
+            artifacts["profile"] = profile[1]
+        else:
+            metrics["profile_unavailable"] = profile[1]
     RunRecord(kind="bench", tool="dmlp_tpu.bench",
-              config=dataclasses.asdict(cfg), metrics=dict(res),
+              config=dataclasses.asdict(cfg), metrics=metrics,
               artifacts=artifacts).append_jsonl(record_path)
 
 
@@ -502,6 +556,12 @@ def main(argv=None) -> int:
     p.add_argument("--counters", action="store_true",
                    help="engine subprocesses print XLA cost-analysis + "
                         "roofline summaries on stderr")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   dest="profile_dir",
+                   help="per-config on-device jax.profiler capture into "
+                        "DIR/profile_configN (real-TPU runs; CPU configs "
+                        "record the profile_unavailable marker), linked "
+                        "from the config's RunRecord artifacts")
     args = p.parse_args(argv)
 
     ids = list(BENCH_CONFIGS) if args.config == "all" else [int(args.config)]
@@ -511,7 +571,8 @@ def main(argv=None) -> int:
                          fast=args.fast, force_oracle=args.force_oracle,
                          timeout_s=args.timeout, reps=args.reps,
                          trace_dir=args.trace_dir, counters=args.counters,
-                         record_path=args.metrics)
+                         record_path=args.metrics,
+                         profile_dir=args.profile_dir)
         ok = ok and res["checksums_match"]
     return 0 if ok else 1
 

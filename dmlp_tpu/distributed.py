@@ -52,6 +52,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--auto", action="store_true",
                    help="let jax.distributed self-detect topology")
+    p.add_argument("--trace", metavar="DIR", default=None,
+                   help="per-rank cluster tracing: every rank writes "
+                        "DIR/trace-rank<NN>.json (distinct Perfetto pid "
+                        "per rank); merge with tools/merge_traces.py")
     args = p.parse_args(argv)
 
     from dmlp_tpu.parallel.distributed import (distributed_contract_run,
@@ -60,12 +64,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                num_processes=args.processes, process_id=args.process_id,
                auto=args.auto)
 
+    tracer = None
+    if args.trace:
+        import os
+
+        import jax
+
+        from dmlp_tpu.obs import dist_trace
+        # Rank identity comes from the cluster runtime; the env override
+        # lets single-process runs emulate a rank of a larger trace set
+        # (used by tools/obs_dist_smoke.py on jax builds whose CPU
+        # backend cannot run multi-process computations at all).
+        rank = int(os.environ.get("DMLP_TPU_TRACE_RANK",
+                                  jax.process_index()))
+        nranks = int(os.environ.get("DMLP_TPU_TRACE_RANKS",
+                                    jax.process_count()))
+        tracer = dist_trace.install(args.trace, rank, nranks)
+
     from dmlp_tpu.cli import make_engine, parse_mesh_arg
     mesh_shape = parse_mesh_arg(p, args.mesh)
     config = EngineConfig(mode=args.mode, mesh_shape=mesh_shape,
                           select=args.select, data_block=args.data_block,
                           use_pallas=args.pallas, debug=args.debug)
     engine = make_engine(config)
+    if tracer is not None:
+        tracer.record_mesh(engine.mesh)
 
     # stdout is the results channel (checksums only — the grader diffs it,
     # survey §4); Gloo's C++ collectives print connection banners straight
@@ -83,6 +106,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         sys.stdout.flush()
         os.dup2(real_stdout, 1)
         os.close(real_stdout)
+        if tracer is not None:
+            # Per-rank file + uninstall AFTER the contract run: the trace
+            # write is filesystem-only, so the stdout/stderr contract
+            # channels stay byte-identical with tracing enabled.
+            from dmlp_tpu.obs import trace as obs_trace
+            try:
+                tracer.write_rank_file(args.trace)
+            finally:
+                obs_trace.uninstall()
     sys.stdout.write(buf.getvalue())
     sys.stdout.flush()
     return 0

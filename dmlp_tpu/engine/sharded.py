@@ -41,6 +41,7 @@ from dmlp_tpu.obs.trace import span as obs_span
 from dmlp_tpu.ops.topk import TopK, streaming_topk
 from dmlp_tpu.parallel.collectives import allgather_merge_topk, ring_allreduce_topk
 from dmlp_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS, make_mesh
+from dmlp_tpu.utils.compat import shard_map
 
 
 def _chunk_span(sc, ck: int):
@@ -183,7 +184,7 @@ class ShardedEngine:
                     return allgather_merge_topk(top, k, DATA_AXIS)
                 return ring_allreduce_topk(top, k, DATA_AXIS)
 
-            sharded = jax.shard_map(
+            sharded = shard_map(
                 local, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                           P(QUERY_AXIS, None)),
@@ -247,7 +248,7 @@ class ShardedEngine:
                                          kc=k, interpret=interpret)
                 return od[None], oi[None]
 
-            self._fns[key] = jax.jit(jax.shard_map(
+            self._fns[key] = jax.jit(shard_map(
                 local, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, QUERY_AXIS, None),
                           P(DATA_AXIS, QUERY_AXIS, None),
@@ -283,7 +284,7 @@ class ShardedEngine:
                     return allgather_merge_topk(top, k, DATA_AXIS)
                 return ring_allreduce_topk(top, k, DATA_AXIS)
 
-            self._fns[key] = jax.jit(jax.shard_map(
+            self._fns[key] = jax.jit(shard_map(
                 local, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, QUERY_AXIS, None),
                           P(DATA_AXIS, QUERY_AXIS, None), P()),
@@ -327,7 +328,7 @@ class ShardedEngine:
                            blabels, bids)
                 return top.dists[None], top.labels[None], top.ids[None]
 
-            self._fns[key] = jax.jit(jax.shard_map(
+            self._fns[key] = jax.jit(shard_map(
                 local, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, QUERY_AXIS, None),
                           P(DATA_AXIS, QUERY_AXIS, None),
@@ -351,7 +352,7 @@ class ShardedEngine:
                     return allgather_merge_topk(top, ko, DATA_AXIS)
                 return ring_allreduce_topk(top, ko, DATA_AXIS)
 
-            self._fns[key] = jax.jit(jax.shard_map(
+            self._fns[key] = jax.jit(shard_map(
                 local, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, QUERY_AXIS, None),
                           P(DATA_AXIS, QUERY_AXIS, None),
@@ -649,7 +650,7 @@ class ShardedEngine:
                     top = select_topk(top.dists, top.labels, top.ids, k)
                 return jax.tree.map(lambda t: t[None], top)  # (1, qloc, K)
 
-            sharded = jax.shard_map(
+            sharded = shard_map(
                 local, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                           P(QUERY_AXIS, None)),
@@ -664,8 +665,14 @@ class ShardedEngine:
         (TopK of shape (R, Qpad, K), sharded over both mesh axes)."""
         select, data_block, k = self._plan_shard(d_attrs, q_attrs, kmax,
                                                  merged_width=False)
-        return self._fn_local(k, data_block, select)(d_attrs, d_labels,
-                                                     d_ids, q_attrs)
+        fn = self._fn_local(k, data_block, select)
+        obs_counters.record_dispatch(fn, (d_attrs, d_labels, d_ids,
+                                          q_attrs),
+                                     site="sharded.solve_local_shards")
+        r, c = self.mesh.devices.shape
+        with obs_span("sharded.solve_local_shards", select=select,
+                      mesh=[r, c], kcap=k):
+            return fn(d_attrs, d_labels, d_ids, q_attrs)
 
     def run(self, inp: KNNInput) -> List[QueryResult]:
         from dmlp_tpu.engine.single import staging_for_k
@@ -764,7 +771,7 @@ class ShardedEngine:
                 predicted = majority_vote(top.labels, valid, num_labels)
                 return predicted, rids, rd
 
-            sharded = jax.shard_map(
+            sharded = shard_map(
                 local, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                           P(QUERY_AXIS, None), P(QUERY_AXIS)),

@@ -549,6 +549,13 @@ class SingleChipEngine:
                 if hi > lo:
                     a[:hi - lo] = src_attrs[lo:hi]
                 da = jnp.asarray(a, self._dtype)
+                if c == 0:
+                    # Resolved via the analytic kernel model
+                    # (obs.kernel_cost) — pallas_call has no XLA cost.
+                    obs_counters.record_dispatch(
+                        extract_topk, (q_dev, da), statics=dict(kc=k),
+                        count=min(nchunks, -(-n // chunk_rows)),
+                        site="single.extract_topk")
                 od, oi, _iters = extract_topk(
                     q_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=k,
                     interpret=interpret)
@@ -668,6 +675,10 @@ class SingleChipEngine:
             a = np.zeros((chunk_rows, na), np.float32)
             a[:hi - lo] = src_attrs[lo:hi]
             da = jnp.asarray(a, self._dtype)
+            if c == 0:
+                obs_counters.record_dispatch(
+                    extract_topk, (q_dev, da), statics=dict(kc=kc),
+                    count=n_staged, site="single.extract_mp_pass1")
             chunks.append((da, lo, hi))
             od, oi, _ = extract_topk(q_dev, da, od, oi, n_real=hi - lo,
                                      id_base=lo, kc=kc, interpret=interpret)
@@ -697,6 +708,10 @@ class SingleChipEngine:
             else jnp.concatenate([c[0] for c in chunks], axis=0)
         del chunks  # free the duplicate once the concat is enqueued —
         # otherwise the dataset is HBM-resident TWICE for the whole sweep
+        if npasses > 1:
+            obs_counters.record_dispatch(
+                extract_topk, (q_dev, d_full), statics=dict(kc=kc),
+                count=npasses - 1, site="single.extract_mp_resident")
         fds = []
         for _p in range(1, npasses):
             floor_dev, fd = _mp_floor(ods[-1], qn_dev, dn_max,
@@ -813,6 +828,11 @@ class SingleChipEngine:
             if hi > lo:
                 a[:hi - lo] = src_attrs[lo:hi]
             da = jnp.asarray(a, self._dtype)
+            if c == 0:
+                obs_counters.record_dispatch(
+                    extract_topk, (qb_dev, da), statics=dict(kc=kb),
+                    count=min(nchunks, -(-n // chunk_rows)),
+                    site="single.extract_bulk")
             od, oi, _iters = extract_topk(
                 qb_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=kb,
                 interpret=interpret)

@@ -10,15 +10,24 @@ timing/metrics schemas:
 - :mod:`dmlp_tpu.obs.trace` — lightweight span tracer exporting
   Chrome-trace / Perfetto-loadable JSON, with an optional bridge to
   ``jax.profiler`` annotations on real TPUs.
+- :mod:`dmlp_tpu.obs.dist_trace` — the multi-process half: per-rank
+  tracers (rank = Perfetto pid) writing ``trace-rank<NN>.json`` with
+  barrier-stamped clock-sync markers; ``tools/merge_traces.py`` merges
+  the rank files into one aligned multi-process trace.
 - :mod:`dmlp_tpu.obs.counters` — static per-dispatch FLOPs / HBM-bytes
   counters from XLA's ``compiled.cost_analysis()``, with an
   achieved-vs-peak roofline summary.
+- :mod:`dmlp_tpu.obs.kernel_cost` — analytic FLOPs/bytes models for the
+  Pallas kernels (which expose no XLA cost model); the counters probe
+  resolves registered kernels through these instead of reporting
+  ``counters_unavailable``.
 - :mod:`dmlp_tpu.obs.comms` — analytic collective-traffic accounting
   (bytes per mesh axis for the all-gather merge, the ring ``ppermute``
-  merge, grad ``psum``, and the MoE all-to-all).
+  merge, grad ``psum``, the MoE all-to-all, and the pipeline's
+  activation ``ppermute``).
 - :mod:`dmlp_tpu.obs.run` — the versioned :class:`RunRecord` artifact
   writer all emitters share (replacing the divergent ``BENCH_*.json``
-  shapes going forward).
+  shapes going forward; the legacy ``tools/*`` emitters are migrated).
 
 Every module here is import-light: none of them import jax at module
 level, so the CLI's fast startup path is unaffected when observability is

@@ -20,7 +20,11 @@ Covered collectives:
   2*(D-1)/D of the gradient bytes per device);
 - the MoE all-to-all dispatch (train.experts._moe_a2a_body): three
   ``lax.all_to_all`` ops per step (tokens out, slot metadata, tokens
-  back), each moving (EP-1)/EP of its buffer off-device.
+  back), each moving (EP-1)/EP of its buffer off-device;
+- the pipeline's activation hand-off (train.pipeline): one microbatch
+  activation ``ppermute`` over the pp axis per schedule tick — a
+  (S-1)-link chain for gpipe, an S-link ring for the interleaved
+  schedule.
 
 All functions return :class:`CollectiveTraffic` records; ``summarize``
 folds a list of them into a per-axis byte table for RunRecord embedding.
@@ -123,6 +127,43 @@ def moe_a2a_traffic(ep: int, capacity: int, hidden: int,
                                   f"+ {meta} B meta, (ep-1)/ep off-device")
 
 
+def pipeline_ppermute_traffic(pp: int, n_micro: int, micro_rows: int,
+                              hidden: int, schedule: str = "gpipe",
+                              n_virtual: int = 1, itemsize: int = 4,
+                              n_groups: int = 1, count: int = 1,
+                              ) -> CollectiveTraffic:
+    """The dp_pp pipeline's activation hand-off: every schedule tick
+    ``ppermute``s one (micro_rows, hidden) activation block per sending
+    link of the pp axis.
+
+    The tick counts restate train.pipeline.schedule_ticks (kept in sync
+    by test; comms must not import the optax-heavy train package):
+    gpipe runs M + S - 1 ticks over an (S-1)-link chain (the last stage
+    forwards nothing); interleaved runs M - 1 + V*S ticks over the
+    S-link ring (the S-1 -> 0 wraparound carries the level-up hop).
+    XLA's ppermute moves the block even on bubble ticks — masking is
+    data-, not schedule-level — so ticks, not useful microbatches, is
+    the honest multiplier. The forward count is reported; the backward
+    pass's reverse-schedule permutes mirror it 1:1 (jax.grad through
+    the scan), which ``count`` can absorb (2 * steps for fwd+bwd).
+    """
+    if schedule == "gpipe":
+        ticks, links = n_micro + pp - 1, max(pp - 1, 0)
+    elif schedule == "interleaved":
+        # A single-stage "ring" dispatches no ppermute at all
+        # (train.pipeline._ppi_body skips it when n_stages == 1).
+        ticks, links = n_micro - 1 + n_virtual * pp, pp if pp > 1 else 0
+    else:
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    payload = micro_rows * hidden * itemsize
+    total = ticks * links * payload      # one dispatch, all links
+    per_dev = 0 if pp <= 0 else round(total / pp)
+    return CollectiveTraffic("ppermute_pipeline", "pp", pp, per_dev,
+                             per_dev, n_groups=n_groups, count=count,
+                             note=f"{schedule}: {ticks} ticks x {links} "
+                                  f"links x {payload} B activation")
+
+
 def engine_comms(merge_strategy: str, mesh_shape, q_local: int,
                  k: int) -> List[CollectiveTraffic]:
     """Traffic for one mesh-engine solve, from the shapes actually
@@ -151,10 +192,15 @@ def summarize(traffics: List[CollectiveTraffic]) -> Dict[str, object]:
 
 def train_step_comms(param_bytes: int, mesh_shape, steps: int = 1,
                      moe: Optional[dict] = None,
+                     pipeline: Optional[dict] = None,
                      ) -> List[CollectiveTraffic]:
     """Per-run traffic for the train loop's collective paths: the grad
     ``psum`` over the dp axis, plus the MoE all-to-all when the a2a
-    dispatch runs (``moe`` = {"ep", "capacity", "hidden"}).
+    dispatch runs (``moe`` = {"ep", "capacity", "hidden"}), plus the
+    pipeline's activation ``ppermute`` when the dp_pp/dp_pp3 step runs
+    (``pipeline`` = {"pp", "n_micro", "micro_rows", "hidden"}
+    [+ "schedule", "n_virtual"]; the record covers forward AND the
+    mirrored backward-schedule permutes — 2x per step).
 
     ``param_bytes`` is the GLOBAL parameter footprint; every non-dp mesh
     axis (tp / pp / ep) shards the parameters — and hence the gradients
@@ -176,4 +222,11 @@ def train_step_comms(param_bytes: int, mesh_shape, steps: int = 1,
         out.append(moe_a2a_traffic(moe["ep"], moe["capacity"],
                                    moe["hidden"], n_groups=dp,
                                    count=steps))
+    if pipeline:
+        out.append(pipeline_ppermute_traffic(
+            pipeline["pp"], pipeline["n_micro"], pipeline["micro_rows"],
+            pipeline["hidden"], schedule=pipeline.get("schedule", "gpipe"),
+            n_virtual=pipeline.get("n_virtual", 1),
+            n_groups=pipeline.get("n_groups", dp),
+            count=2 * steps))  # forward + reverse-schedule backward
     return out

@@ -10,11 +10,16 @@ alive), and the probe *collects* after the timed region by re-lowering
 each unique (function, shapes, statics) signature once and multiplying by
 its dispatch count.
 
-Cost analysis is best-effort across backends and program kinds (Pallas
-kernels, for one, typically expose no XLA cost model): every per-entry
-failure is swallowed and counted as ``skipped``; a collection where
-nothing was analyzable returns ``{"counters_unavailable": True}`` — the
-explicit marker the CLI metrics contract requires instead of silence.
+Cost analysis is best-effort across backends and program kinds: every
+per-entry failure is swallowed and counted as ``skipped``; a collection
+where nothing was analyzable returns ``{"counters_unavailable": True}``
+— the explicit marker the CLI metrics contract requires instead of
+silence. Pallas kernels expose no XLA cost model at all, so the flagship
+extract/distance kernels resolve through the analytic per-kernel models
+in :mod:`dmlp_tpu.obs.kernel_cost` instead (consulted first — XLA's
+numbers for an interpret-mode Pallas program would measure the
+emulation); analytically-resolved dispatch counts are reported
+separately as ``dispatches_analytic_model``.
 
 The roofline summary reuses the training side's per-chip peak table
 (train.metrics.PEAK_FLOPS_BY_KIND) so KNN solves and train steps report
@@ -99,13 +104,21 @@ class CostProbe:
 
         Returns summed ``flops`` / ``bytes_accessed`` with per-site
         breakdown, or ``{"counters_unavailable": True, ...}`` when no
-        signature was analyzable (e.g. a backend with no cost model)."""
+        signature was analyzable (e.g. a backend with no cost model).
+        Functions with a registered analytic model (the Pallas kernels,
+        obs.kernel_cost) resolve through it instead of XLA."""
+        from dmlp_tpu.obs import kernel_cost
+
         flops = byts = 0.0
-        analyzed = skipped = dispatches = 0
+        analyzed = skipped = dispatches = analytic = 0
         per_site: Dict[str, Dict[str, float]] = {}
         for fn, specs, statics, count, site in self._entries.values():
             dispatches += count
-            cost = lowered_cost(fn, *specs, **statics)
+            cost = kernel_cost.analytic_cost(fn, specs, statics)
+            if cost is not None:
+                analytic += count
+            else:
+                cost = lowered_cost(fn, *specs, **statics)
             if cost is None:
                 skipped += count
                 continue
@@ -127,6 +140,10 @@ class CostProbe:
             "dispatches_recorded": dispatches,
             "dispatches_analyzed": analyzed,
         }
+        if analytic:
+            # Name the modeled share: these dispatches carry analytic
+            # (obs.kernel_cost) numbers, not XLA cost analysis.
+            out["dispatches_analytic_model"] = analytic
         if skipped:
             # No silent caps: name what the totals do NOT cover.
             out["dispatches_skipped_no_cost_model"] = skipped

@@ -1,0 +1,123 @@
+"""Analytic FLOPs / HBM-bytes models for the Pallas kernels.
+
+XLA's cost analysis returns nothing for ``pallas_call`` programs, so the
+flagship extract path reported ``counters_unavailable`` on TPU (ROADMAP
+open item). But the kernels' work is a closed-form function of their
+dispatch shapes — the grid, tile sizes, and block sweep are all decided
+before launch — so this module models each kernel analytically and
+:mod:`dmlp_tpu.obs.counters` consults the registry as the resolution
+path for these functions (before attempting XLA cost analysis, whose
+numbers for an interpret-mode Pallas program would measure the
+emulation, not the kernel).
+
+Model scope, per kernel:
+
+- **flops** count the deterministic arithmetic: the MXU cross-term
+  matmul (2*Q*B*A — the same convention XLA uses for dot), the norm
+  reductions, and the elementwise norm-expansion epilogue. The extract
+  kernel's while-loop passes are data-dependent (≈1 pass per warm block,
+  tools/roofline_extract.py measures the real term) and are NOT counted
+  — the model is the deterministic lower bound, exactly what a roofline
+  comparison wants.
+- **bytes_accessed** count HBM traffic implied by the BlockSpec sweep:
+  each query tile re-reads the data panel and each data block re-reads
+  the query panel (Pallas streams blocks from HBM each grid step; only
+  the revisited output blocks stay VMEM-resident), plus the outputs.
+  Operands are streamed as f32 (both kernels cast on entry).
+
+The distance model's matmul term is validated against XLA's own cost
+analysis of the equivalent non-Pallas ``ops.distance`` dispatch
+(tests/test_obs_dist.py, 5% tolerance).
+
+Import-light: the ops modules (and hence jax) load only when a cost is
+actually resolved.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+__all__ = ["extract_topk_cost", "fused_dist_segmin_cost", "analytic_cost"]
+
+
+def extract_topk_cost(qb: int, b: int, a: int, kc: int) -> Dict[str, float]:
+    """Deterministic cost of one ``ops.pallas_extract.extract_topk``
+    dispatch at (queries (qb, a), data (b, a), list width kc)."""
+    from dmlp_tpu.ops.pallas_distance import _tile
+    from dmlp_tpu.ops.pallas_extract import _TN, _resolve_variant
+
+    v = _resolve_variant(kc, b)
+    tq = _tile(qb, v["tile_q"], 8)
+    tn = _tile(b, _TN, 128 * v["ne"])
+    flops = (2.0 * qb * b * a      # MXU cross-term block
+             + 2.0 * (qb + b) * a  # |q|^2 / |d|^2 norm reductions
+             + 4.0 * qb * b)       # expansion + clamp + floor/sentinel masks
+    byts = 4.0 * ((qb // tq) * b * a    # data panel, once per query tile
+                  + (b // tn) * qb * a  # query panel, once per data block
+                  + (qb // tq) * b      # dn row, once per query tile
+                  + (b // tn) * qb      # qn column, once per data block
+                  + 2 * qb * kc         # running (dists, ids) lists out
+                  + qb // tq * (b // tn))  # iteration diagnostics
+    return {"flops": flops, "bytes_accessed": byts}
+
+
+def fused_dist_segmin_cost(qb: int, b: int, a: int) -> Dict[str, float]:
+    """Deterministic cost of one ``ops.pallas_distance.fused_dist_segmin``
+    dispatch: the distance tile is written to HBM (unlike extract) plus
+    one 128-wide segment-min pass while the block is in VMEM."""
+    from dmlp_tpu.ops.pallas_distance import _TN, _TQ, SEG, _tile
+
+    tq = _tile(qb, _TQ, SEG)
+    tn = _tile(b, _TN, 8 * SEG)
+    flops = (2.0 * qb * b * a
+             + 2.0 * (qb + b) * a
+             + 4.0 * qb * b        # expansion + clamp + sentinel mask
+             + 1.0 * qb * b)       # segment-min reduction
+    byts = 4.0 * ((qb // tq) * b * a
+                  + (b // tn) * qb * a
+                  + (qb // tq) * 2 * b   # dn + ids rows, per query tile
+                  + (b // tn) * qb       # qn column, per data block
+                  + qb * b               # the (Qb, B) distance tile out
+                  + qb * (b // SEG))     # the transposed segmin out
+    return {"flops": flops, "bytes_accessed": byts}
+
+
+def _extract_entry(specs, statics) -> Optional[Dict[str, float]]:
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves(specs)
+        (qb, a), (b, _) = leaves[0].shape, leaves[1].shape
+        kc = int(statics["kc"])
+    except Exception:
+        return None
+    return extract_topk_cost(qb, b, a, kc)
+
+
+def _segmin_entry(specs, statics) -> Optional[Dict[str, float]]:
+    del statics
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves(specs)
+        (qb, a), (b, _) = leaves[0].shape, leaves[1].shape
+    except Exception:
+        return None
+    return fused_dist_segmin_cost(qb, b, a)
+
+
+def analytic_cost(fn, specs, statics: Optional[dict] = None
+                  ) -> Optional[Dict[str, float]]:
+    """The registered analytic cost of one dispatch of ``fn`` at the
+    recorded shape specs, or None when ``fn`` has no model (the caller
+    then falls through to XLA cost analysis). Never raises."""
+    try:
+        from dmlp_tpu.ops import pallas_distance, pallas_extract
+        models = {
+            id(pallas_extract.extract_topk): _extract_entry,
+            id(pallas_distance.fused_dist_segmin): _segmin_entry,
+        }
+        entry = models.get(id(fn))
+        if entry is None:
+            return None
+        return entry(specs, dict(statics or {}))
+    except Exception:
+        return None

@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dmlp_tpu.utils.compat import tpu_compiler_params
+
 SEG = 128  # candidate-segment width = one TPU lane row
 
 _TQ = 1024  # query rows per tile (also the segmin lane dim -> 128-multiple)
@@ -122,7 +124,7 @@ def fused_dist_segmin(q_attrs: jax.Array, d_attrs: jax.Array,
         ],
         # HIGHEST-precision dot needs headroom past the default 16M scoped
         # limit at the full (1024, 1024) tile.
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=32 * 2**20),
+        compiler_params=tpu_compiler_params(vmem_limit_bytes=32 * 2**20),
         interpret=interpret,
     )(q32, d32, qn, dn, ids2)
     return dist, segmin_t.T

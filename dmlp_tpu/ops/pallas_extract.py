@@ -42,6 +42,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dmlp_tpu.utils.compat import tpu_compiler_params
+
 from dmlp_tpu.ops.pallas_distance import _tile
 
 # Swept on v5e at 204800 x 10240 x 64, kc=40 (r3): small query tiles win
@@ -301,7 +303,7 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
             jax.ShapeDtypeStruct((qb, b // tn), jnp.int32),
         ],
         scratch_shapes=[pltpu.VMEM((tq, tn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=96 * 2**20),
         interpret=interpret,

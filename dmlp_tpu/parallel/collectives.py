@@ -22,6 +22,7 @@ from __future__ import annotations
 import jax
 
 from dmlp_tpu.ops.topk import TopK, merge_topk, select_topk
+from dmlp_tpu.utils.compat import axis_size
 
 
 def allgather_merge_topk(local: TopK, k: int, axis_name: str) -> TopK:
@@ -46,7 +47,7 @@ def ring_allreduce_topk(local: TopK, k: int, axis_name: str) -> TopK:
     disjoint, so no candidate appears twice — duplicates would be able to
     evict genuine top-k entries).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         # Still re-select: both merges promise selection-ordered output,
         # and the extraction kernel's per-shard lists arrive UNSORTED —

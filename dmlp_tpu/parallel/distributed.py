@@ -487,6 +487,8 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
 
     from dmlp_tpu.engine.finalize import finalize_host
     from dmlp_tpu.io.report import format_results
+    from dmlp_tpu.obs import dist_trace
+    from dmlp_tpu.obs.trace import span as obs_span
 
     out = out if out is not None else sys.stdout
     err = err if err is not None else sys.stderr
@@ -494,7 +496,8 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
     # Parse outside the timed region (the reference starts its timer after
     # rank-0 stdin ingest, common.cpp:119-124); device placement — the
     # Scatterv analog — happens inside solve(), which IS timed there.
-    parsed = read_local_inputs(path, engine)
+    with obs_span("dist.read_local_inputs"):
+        parsed = read_local_inputs(path, engine)
     params, ks, local = parsed["params"], parsed["ks"], parsed["local"]
 
     def solve_segment(ga, gl, gi, gq, ks_seg, q64_seg, idx):
@@ -503,17 +506,23 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
         None)."""
         nqs = len(ks_seg)
         kmax = int(ks_seg.max()) if nqs else 1
-        top = engine.solve_local_shards(ga, gl, gi, gq, kmax)
+        with obs_span("dist.solve_local_shards", nq=nqs, kmax=kmax) as sp:
+            top = engine.solve_local_shards(ga, gl, gi, gq, kmax)
+            sp.fence(top.dists)
         local_s = dict(local, query_attrs=q64_seg)
-        my_d, my_l, my_i = rescore_local_shards(
-            top, local_s, ks_seg, nqs,
-            staging=engine._staging)
+        with obs_span("dist.rescore_local_shards", nq=nqs):
+            my_d, my_l, my_i = rescore_local_shards(
+                top, local_s, ks_seg, nqs,
+                staging=engine._staging)
 
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
-            all_d = multihost_utils.process_allgather(my_d)
-            all_l = multihost_utils.process_allgather(my_l)
-            all_i = multihost_utils.process_allgather(my_i)
+            with obs_span("dist.allgather_candidates",
+                          nbytes=int(my_d.nbytes + my_l.nbytes
+                                     + my_i.nbytes)):
+                all_d = multihost_utils.process_allgather(my_d)
+                all_l = multihost_utils.process_allgather(my_l)
+                all_i = multihost_utils.process_allgather(my_i)
             my_d = all_d.min(axis=0)
             my_l = all_l.max(axis=0)
             my_i = all_i.max(axis=0)
@@ -521,9 +530,10 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
         # (R, Qpad, K) -> (Q, R*K): per query, all shards' candidates.
         r_axis, qpad, kcap = my_d.shape
         flat = lambda x: x.transpose(1, 0, 2).reshape(qpad, r_axis * kcap)  # noqa: E731
-        return finalize_host(flat(my_d)[:nqs], flat(my_l)[:nqs],
-                             flat(my_i)[:nqs], ks_seg, q64_seg, None,
-                             exact=False, query_ids=idx)
+        with obs_span("dist.finalize", nq=nqs):
+            return finalize_host(flat(my_d)[:nqs], flat(my_l)[:nqs],
+                                 flat(my_i)[:nqs], ks_seg, q64_seg, None,
+                                 exact=False, query_ids=idx)
 
     def solve():
         from dmlp_tpu.engine.single import hetk_split, round_up
@@ -534,7 +544,8 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
         split = hetk_split(engine.config, engine._staging,
                            ks, n, round_up(max(-(-n // r), 1), 8))
         if split is None:
-            ga, gl, gi, gq = place_global_inputs(engine, parsed)
+            with obs_span("dist.place_global_inputs"):
+                ga, gl, gi, gq = place_global_inputs(engine, parsed)
             return solve_segment(ga, gl, gi, gq, ks,
                                  local["query_attrs"], None)
 
@@ -558,12 +569,20 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
     kmax_all = int(ks.max()) if params.num_queries else 0
     with staging_for_k(engine, kmax_all):
         if warmup:
-            solve()
+            with obs_span("dist.warmup"):
+                solve()
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("dmlp_tpu.contract.start")
+        # The barrier releases every rank within network latency of one
+        # wall instant — the clock-sync stamp merge_traces aligns rank
+        # timelines on. Single-process runs stamp here too (zero-offset
+        # reference point, so the merge tool needs no special case).
+        dist_trace.clock_sync()
         t0 = time.perf_counter()
-        results = solve()
+        with obs_span("dist.solve", rank=jax.process_index(),
+                      nq=params.num_queries, n=params.num_data):
+            results = solve()
         elapsed_ms = (time.perf_counter() - t0) * 1e3
     if jax.process_index() == 0:
         out.write(format_results(results, debug=engine.config.debug))

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from dmlp_tpu.utils.compat import axis_size, shard_map
 
 DP_AXIS = "dp"
 EP_AXIS = "ep"
@@ -143,7 +144,7 @@ def make_moe_train_step(mesh: Mesh, optimizer: optax.GradientTransformation,
     n_dp = mesh.devices.shape[0]
     body = functools.partial(_moe_body, n_experts=n_experts,
                              n_classes=n_classes)
-    sharded_loss = jax.shard_map(
+    sharded_loss = shard_map(
         body, mesh=mesh,
         in_specs=(MOE_PSPECS, P(DP_AXIS, None), P(DP_AXIS)),
         out_specs=(P((DP_AXIS, EP_AXIS)), P((DP_AXIS, EP_AXIS))),
@@ -176,7 +177,7 @@ def build_moe_state(mesh: Mesh, optimizer, d_in: int, hidden: int, ffn: int,
 def _moe_a2a_body(params, x, y, *, n_experts: int, n_classes: int,
                   capacity: int):
     ep_idx = jax.lax.axis_index(EP_AXIS)
-    n_ep = jax.lax.axis_size(EP_AXIS)
+    n_ep = axis_size(EP_AXIS)
     e_local = params["up"].shape[0]
     bl, hdim = x.shape[0], params["in_w"].shape[1]
 
@@ -273,7 +274,7 @@ def make_moe_a2a_train_step(mesh: Mesh,
                          "the experts)")
     body = functools.partial(_moe_a2a_body, n_experts=n_experts,
                              n_classes=n_classes, capacity=capacity)
-    sharded_loss = jax.shard_map(
+    sharded_loss = shard_map(
         body, mesh=mesh,
         in_specs=(MOE_PSPECS, P((DP_AXIS, EP_AXIS), None),
                   P((DP_AXIS, EP_AXIS))),

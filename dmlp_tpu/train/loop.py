@@ -81,8 +81,10 @@ def build_sharded_state(mesh, dims, optimizer, seed: int = 0,
         # Init in HBM first, then evict: eager zeros_like on a host-memory
         # array trips a make_array_from_callback memory-kind mismatch in
         # this JAX, so optimizer moments can't be *created* there directly.
+        from dmlp_tpu.utils.compat import host_memory_kind
+        hk = host_memory_kind()
         to_host = lambda a: jax.device_put(  # noqa: E731
-            a, a.sharding.with_memory_kind("pinned_host"))
+            a, a.sharding.with_memory_kind(hk))
         state["params"] = jax.tree.map(to_host, state["params"])
         if level == "all":
             state["opt"] = jax.tree.map(to_host, state["opt"])
@@ -250,7 +252,9 @@ def train(steps: int = 100, batch: int = 1024,
     # the a2a dispatch runs — logged once so per-step records stay small.
     if metrics is not None:
         comms = _train_comms(state, mesh, parallelism, dims, batch,
-                             moe_dispatch, capacity_factor, steps)
+                             moe_dispatch, capacity_factor, steps,
+                             n_micro=n_micro, pp_schedule=pp_schedule,
+                             n_virtual=n_virtual)
         if comms is not None:
             metrics.log(event="comms", **comms)
 
@@ -285,7 +289,8 @@ def train(steps: int = 100, batch: int = 1024,
 
 def _train_comms(state, mesh, parallelism: str, dims, batch: int,
                  moe_dispatch: str, capacity_factor: float,
-                 steps: int) -> Optional[dict]:
+                 steps: int, n_micro: int = 4, pp_schedule: str = "gpipe",
+                 n_virtual: int = 1) -> Optional[dict]:
     """obs.comms summary for this run's collective paths, from the real
     mesh/param shapes; None when the run dispatches no collectives."""
     import numpy as _np
@@ -301,8 +306,23 @@ def _train_comms(state, mesh, parallelism: str, dims, batch: int,
         dp, ep = mesh.devices.shape
         moe = {"ep": ep, "hidden": dims[1],
                "capacity": a2a_capacity(batch, dp, ep, capacity_factor)}
+    pipeline = None
+    if parallelism in ("dp_pp", "dp_pp3"):
+        # Activation hand-off shapes exactly as the step dispatches them:
+        # each dp cell's local batch splits into n_micro microbatches of
+        # (micro_rows, hidden) f32 activations; the ppermute runs
+        # independently per (dp[, tp]) cell group.
+        dp, pp = mesh.devices.shape[0], mesh.devices.shape[-1]
+        groups = int(_np.prod(mesh.devices.shape[:-1]))
+        sched = pp_schedule if parallelism == "dp_pp" else "gpipe"
+        pipeline = {"pp": pp, "n_micro": n_micro,
+                    "micro_rows": max(batch // dp // max(n_micro, 1), 1),
+                    "hidden": dims[1], "schedule": sched,
+                    "n_virtual": n_virtual if sched == "interleaved" else 1,
+                    "n_groups": groups}
     traffic = obs_comms.train_step_comms(param_bytes, mesh.devices.shape,
-                                         steps=steps, moe=moe)
+                                         steps=steps, moe=moe,
+                                         pipeline=pipeline)
     return obs_comms.summarize(traffic) if traffic else None
 
 

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from dmlp_tpu.utils.compat import shard_map
 
 DP_AXIS = "dp"
 PP_AXIS = "pp"
@@ -213,7 +214,7 @@ def make_pp_train_step(mesh: Mesh, optimizer: optax.GradientTransformation,
     n_dp = mesh.devices.shape[0]
     body = functools.partial(_pp_body, n_stages=n_stages, n_micro=n_micro,
                              n_classes=n_classes)
-    sharded_loss = jax.shard_map(
+    sharded_loss = shard_map(
         body, mesh=mesh,
         in_specs=(PP_PSPECS, P(DP_AXIS, None), P(DP_AXIS)),
         out_specs=(P((DP_AXIS, PP_AXIS)), P((DP_AXIS, PP_AXIS))),
@@ -376,7 +377,7 @@ def make_ppi_train_step(mesh: Mesh, optimizer: optax.GradientTransformation,
             f"({n_micro} > {n_stages}); use the gpipe schedule there")
     body = functools.partial(_ppi_body, n_stages=n_stages, n_micro=n_micro,
                              n_virtual=n_virtual, n_classes=n_classes)
-    sharded_loss = jax.shard_map(
+    sharded_loss = shard_map(
         body, mesh=mesh,
         in_specs=(PPI_PSPECS, P(DP_AXIS, None), P(DP_AXIS)),
         out_specs=(P((DP_AXIS, PP_AXIS)), P((DP_AXIS, PP_AXIS))),
@@ -552,7 +553,7 @@ def make_pp3_train_step(mesh: Mesh, optimizer: optax.GradientTransformation,
     n_dp, _n_tp, n_stages = mesh.devices.shape
     body = functools.partial(_pp3_body, n_stages=n_stages, n_micro=n_micro,
                              n_classes=n_classes)
-    sharded_loss = jax.shard_map(
+    sharded_loss = shard_map(
         body, mesh=mesh,
         in_specs=(PP3_PSPECS, P(DP_AXIS, None), P(DP_AXIS)),
         out_specs=(P((DP_AXIS, TP_AXIS, PP_AXIS)),

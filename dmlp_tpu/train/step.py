@@ -113,8 +113,9 @@ def supports_injit_offload() -> bool:
     """
     try:
         dev = jax.devices()[0]
-        hsh = jax.sharding.SingleDeviceSharding(dev,
-                                                memory_kind="pinned_host")
+        from dmlp_tpu.utils.compat import host_memory_kind
+        hsh = jax.sharding.SingleDeviceSharding(
+            dev, memory_kind=host_memory_kind())
         dsh = jax.sharding.SingleDeviceSharding(dev, memory_kind="device")
         w = jax.device_put(jnp.ones((8,)), hsh)
         f = jax.jit(lambda a: jax.device_put(a, dsh) * 2.0,

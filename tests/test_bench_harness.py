@@ -55,6 +55,25 @@ def test_run_config_exact_mode(tiny_cfg, tmp_path):
     assert res["checksums_match"]
 
 
+def test_run_config_profile_marker_on_cpu(tiny_cfg, tmp_path):
+    """--profile on a CPU-pinned environment is a no-op with the explicit
+    profile_unavailable marker in the config's RunRecord (ROADMAP open
+    item 1: real-TPU runs get the linked jax.profiler capture instead)."""
+    import json
+
+    buf = io.StringIO()
+    record_path = str(tmp_path / "runs.jsonl")
+    res = run_config(1, base_dir=str(tmp_path), out=buf,
+                     profile_dir=str(tmp_path / "prof"),
+                     record_path=record_path)
+    assert res["checksums_match"]
+    assert "profile_unavailable" in buf.getvalue()
+    rec = json.loads(open(record_path).read().splitlines()[-1])
+    assert rec["schema"] == 1
+    assert rec["metrics"]["profile_unavailable"]
+    assert "profile" not in rec.get("artifacts", {})
+
+
 def test_compare_times_report_format():
     out = io.StringIO()
     pct = compare_times("Time taken: 100 ms\n", "Time taken: 80 ms\n", out)

@@ -1,0 +1,381 @@
+"""Distributed observability: per-rank tracing, trace merge, and the
+analytic Pallas kernel-cost models.
+
+Covers obs.dist_trace (rank-pid tracer, clock-sync stamping, rank
+metadata), tools/merge_traces.py (clock alignment, rebase, per-rank
+span cross-checks, missing-rank failure), tools/check_trace.py --dist,
+obs.kernel_cost (analytic extract/distance models, validated against
+XLA's cost analysis of the equivalent non-Pallas distance dispatch),
+the counters fallback path end to end through a real extract-select
+engine run, and obs.comms' pipeline ppermute accounting against
+hand-computed byte counts.
+
+The real 2-process cluster form runs where the jax build supports
+multi-process CPU computations and SKIPS (same root cause as the seed
+suite's 2-process contract failures) where it does not; the merge and
+validation chain is covered either way via in-process rank tracers.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmlp_tpu.obs import counters as obs_counters
+from dmlp_tpu.obs import dist_trace
+from dmlp_tpu.obs import kernel_cost
+from dmlp_tpu.obs import trace as obs_trace
+from dmlp_tpu.obs.comms import pipeline_ppermute_traffic
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# obs.dist_trace — the per-rank tracer
+# ---------------------------------------------------------------------------
+
+def test_dist_tracer_rank_pid_and_metadata(tmp_path):
+    tracer = dist_trace.DistTracer(rank=3, num_ranks=4)
+    with tracer.span("work"):
+        pass
+    tracer.mark_clock_sync()
+    path = tracer.write_rank_file(str(tmp_path))
+    assert path.endswith("trace-rank03.json")
+
+    doc = json.loads(open(path).read())
+    assert doc["dist"]["rank"] == 3
+    assert doc["dist"]["num_ranks"] == 4
+    assert doc["dist"]["clock_sync_ts_us"] is not None
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert all(e["pid"] == 3 for e in spans)  # rank IS the Perfetto pid
+    meta = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "M"}
+    assert {"process_name", "process_sort_index", "process_labels"} <= meta
+    syncs = [e for e in doc["traceEvents"]
+             if e.get("ph") == "i" and e["name"] == "dist.clock_sync"]
+    assert len(syncs) == 1
+
+
+def test_dist_tracer_first_clock_sync_wins():
+    tracer = dist_trace.DistTracer(rank=0, num_ranks=1)
+    tracer.mark_clock_sync()
+    first = tracer._clock_sync_ts_us
+    tracer.mark_clock_sync()
+    assert tracer._clock_sync_ts_us == first
+
+
+def test_clock_sync_hook_noop_for_plain_tracer():
+    plain = obs_trace.install(obs_trace.Tracer())
+    try:
+        dist_trace.clock_sync()   # must not raise, must not record
+        assert not plain.to_dict()["traceEvents"][1:]
+    finally:
+        obs_trace.uninstall()
+    dist_trace.clock_sync()       # uninstalled: no-op
+
+
+# ---------------------------------------------------------------------------
+# tools/merge_traces.py — alignment, rebase, cross-checks
+# ---------------------------------------------------------------------------
+
+def _write_rank(tmp_path, rank, num_ranks, spans=("dist.solve",),
+                sync_first=False):
+    tracer = dist_trace.DistTracer(rank=rank, num_ranks=num_ranks)
+    if sync_first:
+        tracer.mark_clock_sync()
+    for name in spans:
+        with tracer.span(name):
+            pass
+    if not sync_first:
+        tracer.mark_clock_sync()
+    tracer.write_rank_file(str(tmp_path))
+    return tracer
+
+
+def test_merge_aligns_clock_sync_and_rebases(tmp_path):
+    _write_rank(tmp_path, 0, 2, spans=("dist.read_local_inputs",
+                                       "dist.solve"))
+    _write_rank(tmp_path, 1, 2, spans=("dist.read_local_inputs",
+                                       "dist.solve"))
+    merge_traces = _load_tool("merge_traces")
+    doc = merge_traces.merge(str(tmp_path))
+
+    assert doc["dist"]["num_ranks"] == 2
+    assert doc["dist"]["span_counts"] == {"0": 2, "1": 2}
+    ts = [e["ts"] for e in doc["traceEvents"] if "ts" in e]
+    assert min(ts) >= 0.0                      # rebased after alignment
+    # the two ranks' sync instants land on the same merged timestamp
+    syncs = {e["pid"]: e["ts"] for e in doc["traceEvents"]
+             if e.get("ph") == "i" and e["name"] == "dist.clock_sync"}
+    assert set(syncs) == {0, 1}
+    assert abs(syncs[0] - syncs[1]) < 1.0      # us; exact up to rounding
+    # per-rank monotonicity in merged order (the --dist check's invariant)
+    for pid in (0, 1):
+        seq = [e["ts"] for e in doc["traceEvents"]
+               if e.get("pid") == pid and "ts" in e]
+        assert all(b >= a for a, b in zip(seq, seq[1:]))
+
+
+def test_merge_fails_on_missing_rank(tmp_path):
+    _write_rank(tmp_path, 0, 2)   # rank 1 of 2 never wrote its file
+    merge_traces = _load_tool("merge_traces")
+    with pytest.raises(SystemExit):
+        merge_traces.merge(str(tmp_path))
+
+
+def test_merge_fails_on_divergent_solve_counts(tmp_path):
+    _write_rank(tmp_path, 0, 2, spans=("dist.solve", "dist.solve"))
+    _write_rank(tmp_path, 1, 2, spans=("dist.solve",))
+    merge_traces = _load_tool("merge_traces")
+    with pytest.raises(SystemExit):
+        merge_traces.merge(str(tmp_path))
+
+
+def test_check_dist_trace_validates_merged(tmp_path):
+    for rank in range(3):
+        _write_rank(tmp_path, rank, 3)
+    merge_traces = _load_tool("merge_traces")
+    merged = tmp_path / "merged.json"
+    with open(merged, "w") as f:
+        json.dump(merge_traces.merge(str(tmp_path)), f)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_trace.py"),
+         "--dist", str(merged), "--ranks", "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+    # and the checker rejects a wrong rank expectation
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_trace.py"),
+         "--dist", str(merged), "--ranks", "4"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60)
+    assert proc.returncode == 1
+
+
+# ---------------------------------------------------------------------------
+# the real cluster form (spawns OS processes) — skips where the jax build
+# cannot run multi-process CPU computations (the seed suite's known drift)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_two_process_cluster_writes_per_rank_traces(tmp_path):
+    from dmlp_tpu.io.datagen import generate_input_text
+
+    # the spawn recipe lives in ONE place: tools/obs_dist_smoke.py
+    smoke = _load_tool("obs_dist_smoke")
+
+    text = generate_input_text(211, 23, 5, -4, 4, 1, 12, 4, seed=9)
+    path = tmp_path / "in.txt"
+    path.write_text(text)
+    trace_dir = tmp_path / "traces"
+
+    procs, outs = smoke.spawn_traced_cluster(str(path), str(trace_dir),
+                                             procs=2)
+    errs = "\n".join(o[1].decode() for o in outs)
+    if any(p.returncode != 0 for p in procs):
+        if smoke.MULTIPROC_UNSUPPORTED in errs:
+            pytest.skip("this jax build cannot run multi-process CPU "
+                        "computations (same drift as the seed 2-process "
+                        "contract failures)")
+        pytest.fail(errs[-2000:])
+
+    merge_traces = _load_tool("merge_traces")
+    doc = merge_traces.merge(str(trace_dir))
+    assert doc["dist"]["num_ranks"] == 2
+    assert all(v > 0 for v in doc["dist"]["span_counts"].values())
+
+
+# ---------------------------------------------------------------------------
+# obs.kernel_cost — analytic models + counters fallback
+# ---------------------------------------------------------------------------
+
+def test_analytic_distance_flops_match_xla_within_5pct():
+    """The distance-kernel model's FLOPs vs XLA's cost analysis of the
+    equivalent non-Pallas ops.distance dispatch at the same shape."""
+    from dmlp_tpu.ops.distance import pairwise_sq_l2
+
+    qb, b, a = 256, 1024, 128
+    f = jax.jit(pairwise_sq_l2)
+    q = jnp.zeros((qb, a), jnp.float32)
+    d = jnp.zeros((b, a), jnp.float32)
+    xla = obs_counters.lowered_cost(f, q, d)
+    if xla is None:
+        pytest.skip("backend exposes no cost model")
+    ana = kernel_cost.fused_dist_segmin_cost(qb, b, a)
+    # the segmin pass (qb*b flops) is extra work the plain dispatch does
+    # not do; compare the shared distance term
+    shared = ana["flops"] - qb * b
+    assert abs(shared - xla["flops"]) / xla["flops"] < 0.05
+
+
+def test_analytic_extract_model_scales_with_shape():
+    c1 = kernel_cost.extract_topk_cost(128, 12800, 64, 40)
+    c2 = kernel_cost.extract_topk_cost(128, 2 * 12800, 64, 40)
+    assert c2["flops"] > 1.9 * c1["flops"]
+    assert c1["flops"] > 2 * 128 * 12800 * 64          # matmul term floor
+    assert c1["bytes_accessed"] >= 12800 * 64 * 4      # one data sweep
+
+
+def test_probe_resolves_extract_topk_analytically():
+    """The acceptance contract: a recorded pallas extract dispatch yields
+    analytic flops/bytes, NOT counters_unavailable."""
+    from dmlp_tpu.ops.pallas_extract import extract_topk
+
+    probe = obs_counters.CostProbe()
+    q = jnp.zeros((128, 8), jnp.float32)
+    d = jnp.zeros((1280, 8), jnp.float32)
+    probe.record(extract_topk, (q, d), statics=dict(kc=16), count=2,
+                 site="single.extract_topk")
+    got = probe.collect()
+    assert not got.get("counters_unavailable")
+    assert got["dispatches_analytic_model"] == 2
+    want = kernel_cost.extract_topk_cost(128, 1280, 8, 16)
+    assert got["flops"] == pytest.approx(2 * want["flops"])
+    assert got["bytes_accessed"] == pytest.approx(
+        2 * want["bytes_accessed"])
+    assert got["per_site"]["single.extract_topk"]["dispatches"] == 2
+
+
+def test_analytic_cost_unknown_fn_is_none():
+    assert kernel_cost.analytic_cost(lambda x: x, (), {}) is None
+
+
+def test_extract_engine_run_records_analytic_counters():
+    """End to end: an extract-select engine run on the interpret-mode
+    kernel records analytic counters through the installed probe."""
+    from dmlp_tpu.config import EngineConfig
+    from dmlp_tpu.engine.single import SingleChipEngine
+    from dmlp_tpu.io.datagen import generate_input_text
+    from dmlp_tpu.io.grammar import parse_input_text
+
+    inp = parse_input_text(
+        generate_input_text(13000, 16, 6, 0.0, 50.0, 1, 8, 4, seed=7))
+    eng = SingleChipEngine(
+        EngineConfig(select="extract", use_pallas=True, exact=False))
+    probe = obs_counters.install()
+    try:
+        eng.run(inp)
+    finally:
+        obs_counters.uninstall()
+    assert eng._last_select == "extract"
+    got = probe.collect()
+    assert not got.get("counters_unavailable")
+    assert got.get("dispatches_analytic_model", 0) >= 1
+    assert "single.extract_topk" in got.get("per_site", {})
+    assert got["per_site"]["single.extract_topk"]["dispatches"] >= 1
+    assert got["flops"] > 2 * 13000 * 16 * 6   # at least the matmul term
+
+
+# ---------------------------------------------------------------------------
+# obs.comms — pipeline ppermute accounting (hand-computed, 2x2 mesh)
+# ---------------------------------------------------------------------------
+
+def test_pipeline_ppermute_gpipe_2x2_hand_computed():
+    # dp=2, pp=2 (the 2x2 mesh), gpipe, M=4 microbatches of (16, 8) f32
+    # activations: payload = 16*8*4 = 512 B; ticks = M + S - 1 = 5;
+    # links = S - 1 = 1 -> total per group per dispatch = 5 * 512 = 2560.
+    # Per device = 2560 / 2 = 1280; bytes_total = 1280 * 2 * 2 groups.
+    t = pipeline_ppermute_traffic(2, 4, 16, 8, schedule="gpipe",
+                                  n_groups=2)
+    assert t.bytes_out_per_device == 1280
+    assert t.bytes_total == 5120
+    assert t.axis == "pp" and t.axis_size == 2
+
+
+def test_pipeline_ppermute_interleaved_ring_hand_computed():
+    # interleaved: ticks = M - 1 + V*S = 4 - 1 + 2*2 = 7 over the S-link
+    # ring -> 7 * 2 * 512 = 7168 per group; per device 3584.
+    t = pipeline_ppermute_traffic(2, 4, 16, 8, schedule="interleaved",
+                                  n_virtual=2)
+    assert t.bytes_out_per_device == 3584
+    assert t.bytes_total == 7168
+
+
+def test_pipeline_ppermute_ticks_match_schedule_ticks():
+    """comms restates the schedule arithmetic (it must not import the
+    optax-heavy train package); hold the two in sync."""
+    from dmlp_tpu.train.pipeline import schedule_ticks
+
+    for sched, v in (("gpipe", 1), ("interleaved", 3)):
+        for m, s in ((1, 2), (4, 4), (8, 2)):
+            t = pipeline_ppermute_traffic(s, m, 8, 4, schedule=sched,
+                                          n_virtual=v)
+            ticks = schedule_ticks(sched, m, s, v)
+            links = s - 1 if sched == "gpipe" else s
+            assert t.bytes_total == ticks * links * 8 * 4 * 4, (sched, m, s)
+
+
+def test_pipeline_ppermute_single_stage_is_zero():
+    # both schedules skip the ppermute entirely at n_stages == 1
+    # (train.pipeline dispatches `out` directly) — zero bytes, no phantom
+    # single-cell "ring"
+    assert pipeline_ppermute_traffic(1, 4, 16, 8).bytes_total == 0
+    assert pipeline_ppermute_traffic(
+        1, 4, 16, 8, schedule="interleaved", n_virtual=2).bytes_total == 0
+
+
+def test_train_step_comms_includes_pipeline():
+    from dmlp_tpu.obs.comms import summarize, train_step_comms
+
+    traffic = train_step_comms(
+        4096, (2, 2), steps=3,
+        pipeline={"pp": 2, "n_micro": 4, "micro_rows": 16, "hidden": 8})
+    names = {t.collective for t in traffic}
+    assert names == {"psum_grads", "ppermute_pipeline"}
+    pp = next(t for t in traffic if t.collective == "ppermute_pipeline")
+    assert pp.count == 6          # fwd + mirrored bwd, 3 steps
+    # per dispatch: 1280 B/device x pp=2 x dp groups=2 = 5120; x count 6
+    assert summarize(traffic)["bytes_by_axis"]["pp"] == 6 * 5120
+
+
+# ---------------------------------------------------------------------------
+# emulated per-rank contract runs through the real entry point
+# ---------------------------------------------------------------------------
+
+def test_contract_run_with_dist_tracer_records_solve_span(tmp_path):
+    """The in-process form of the traced cluster: a DistTracer installed
+    around distributed_contract_run captures the dist.* spans and the
+    clock-sync stamp, and the per-rank file round-trips the merge."""
+    from dmlp_tpu.config import EngineConfig
+    from dmlp_tpu.engine.sharded import ShardedEngine
+    from dmlp_tpu.io.datagen import generate_input_text
+    from dmlp_tpu.parallel.distributed import distributed_contract_run
+    from dmlp_tpu.parallel.mesh import make_mesh
+
+    text = generate_input_text(97, 11, 4, 0, 9, 1, 10, 3, seed=4)
+    path = tmp_path / "in.txt"
+    path.write_text(text)
+
+    for rank in range(2):
+        tracer = dist_trace.install(str(tmp_path), rank, 2)
+        try:
+            engine = ShardedEngine(
+                EngineConfig(mode="sharded", query_block=8),
+                mesh=make_mesh())
+            distributed_contract_run(str(path), engine,
+                                     out=open(os.devnull, "w"),
+                                     err=open(os.devnull, "w"))
+        finally:
+            obs_trace.uninstall()
+        tracer.write_rank_file(str(tmp_path))
+
+    merge_traces = _load_tool("merge_traces")
+    doc = merge_traces.merge(str(tmp_path))
+    assert doc["dist"]["num_ranks"] == 2
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert "dist.solve" in names
+    assert "dist.rescore_local_shards" in names
+    assert any(n.startswith("sharded.") for n in names)  # engine spans too

@@ -8,8 +8,11 @@ modes INTERLEAVED (A/B/C/A/B/C..., rotating the starting mode each rep) and
 reports per-mode median + spread, so slow-link intervals hit every mode
 equally.
 
-Writes BENCH_MODES_r{N}.json. Env: BENCH_REPS (default 5), BENCH_NUM_DATA /
-BENCH_NUM_QUERIES / BENCH_NUM_ATTRS / BENCH_K as in bench.py, BENCH_OUT.
+Writes one schema-1 RunRecord (obs.run) to BENCH_MODES_r{N}.json — the
+versioned envelope every migrated emitter shares; the interleaved-rep
+methodology and per-mode payload live in ``config``/``metrics``. Env:
+BENCH_REPS (default 5), BENCH_NUM_DATA / BENCH_NUM_QUERIES /
+BENCH_NUM_ATTRS / BENCH_K as in bench.py, BENCH_OUT.
 """
 
 from __future__ import annotations
@@ -38,7 +41,9 @@ def main() -> int:
     num_attrs = _env_int("BENCH_NUM_ATTRS", 64)
     k = _env_int("BENCH_K", 32)
     reps = _env_int("BENCH_REPS", 5)
-    out_path = os.environ.get("BENCH_OUT", "BENCH_MODES_r04.json")
+    # r06+: RunRecord schema (the r04 artifact keeps its grandfathered
+    # ad-hoc shape; this tool stopped emitting it)
+    out_path = os.environ.get("BENCH_OUT", "BENCH_MODES_r06.json")
 
     inp = make_workload(num_data, num_queries, num_attrs, k)
     use_pallas = native_pallas_backend()
@@ -79,23 +84,28 @@ def main() -> int:
                           getattr(engines[m], "last_phase_ms", {}).items()},
             "compile_plus_first_run_ms": compile_ms[m],
         })
-    doc = {
-        "note": "Interleaved A/B/C reps (rotating start), per-mode median + "
-                "spread — link weather hits every mode equally (VERDICT r3 "
-                "item 2). 1-device mesh for sharded/ring unless more chips "
-                "exist; end-to-end engine.run() wall time (fast mode), "
-                "tunneled host link.",
-        "shape": {"num_data": num_data, "num_queries": num_queries,
-                  "num_attrs": num_attrs, "k": k},
-        "platform": jax.devices()[0].platform,
-        "device": str(jax.devices()[0]),
-        "n_devices": len(jax.devices()),
-        "interleaved_reps": reps,
-        "use_pallas": use_pallas,
-        "runs": runs,
-    }
-    with open(out_path, "w") as f:
-        json.dump(doc, f, indent=1)
+    from dmlp_tpu.obs.run import RunRecord
+    RunRecord(
+        kind="bench_modes", tool="tools/bench_modes_ab",
+        config={
+            "shape": {"num_data": num_data, "num_queries": num_queries,
+                      "num_attrs": num_attrs, "k": k},
+            "platform": jax.devices()[0].platform,
+            "device": str(jax.devices()[0]),
+            "n_devices": len(jax.devices()),
+            "interleaved_reps": reps,
+            "use_pallas": use_pallas,
+        },
+        metrics={
+            "note": "Interleaved A/B/C reps (rotating start), per-mode "
+                    "median + spread — link weather hits every mode "
+                    "equally (VERDICT r3 item 2). 1-device mesh for "
+                    "sharded/ring unless more chips exist; end-to-end "
+                    "engine.run() wall time (fast mode), tunneled host "
+                    "link.",
+            "runs": runs,
+        },
+    ).write(out_path)
     print(json.dumps({m: {"median_ms": r["median_ms"],
                           "spread": [r["min_ms"], r["max_ms"]]}
                       for m, r in zip(modes, runs)}))

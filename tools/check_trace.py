@@ -14,9 +14,17 @@ structural contract the obs subsystem promises —
   either cost-analysis flops/bytes or the explicit
   ``counters_unavailable`` marker — never silence.
 
+``--dist MERGED.json [--ranks N]`` instead validates a merged multi-rank
+cluster trace (tools/merge_traces.py output): the expected number of
+distinct rank pids, per-rank process metadata events and clock-sync
+markers, per-rank spans including the contract ``dist.solve`` span, and
+monotonic (sorted, non-negative) per-rank timestamps after alignment —
+the `make obs-dist-smoke` checker.
+
 Exit 0 on success, 1 with a message naming the first violated invariant.
 
 Usage: python tools/check_trace.py TRACE.json METRICS.jsonl
+       python tools/check_trace.py --dist MERGED.json [--ranks N]
 """
 
 from __future__ import annotations
@@ -93,8 +101,86 @@ def check_metrics(path: str) -> None:
                   f"bytes={counters['bytes_accessed']:.4g}"))
 
 
+def check_dist_trace(path: str, expect_ranks: int = None) -> None:
+    """Structural contract of a merged multi-rank trace
+    (tools/merge_traces.py output)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        fail(f"merged trace {path} unreadable: {e}")
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        fail(f"merged trace {path}: traceEvents missing or empty")
+
+    spans_by_pid, meta_by_pid, sync_by_pid, ts_by_pid = {}, {}, {}, {}
+    for e in events:
+        pid = e.get("pid")
+        if pid is None:
+            fail(f"merged trace {path}: event {e} has no pid")
+        ph = e.get("ph")
+        if ph == "M":
+            meta_by_pid.setdefault(pid, set()).add(e.get("name"))
+            continue
+        if "ts" in e:
+            if not isinstance(e["ts"], (int, float)) or e["ts"] < 0:
+                fail(f"merged trace {path}: pid {pid} event "
+                     f"{e.get('name')} has bad ts {e.get('ts')!r} "
+                     "(negative or non-numeric after alignment)")
+            ts_by_pid.setdefault(pid, []).append(e["ts"])
+        if ph == "X":
+            spans_by_pid.setdefault(pid, []).append(e)
+        elif ph == "i" and e.get("name") == "dist.clock_sync":
+            sync_by_pid.setdefault(pid, 0)
+            sync_by_pid[pid] += 1
+
+    pids = sorted(spans_by_pid)
+    n = expect_ranks if expect_ranks is not None \
+        else doc.get("dist", {}).get("num_ranks", len(pids))
+    if len(pids) != n or pids != list(range(n)):
+        fail(f"merged trace {path}: expected {n} distinct rank pids "
+             f"0..{n - 1} with spans, got {pids}")
+    for pid in pids:
+        if "process_name" not in meta_by_pid.get(pid, set()):
+            fail(f"merged trace {path}: rank {pid} has no process_name "
+                 "metadata event")
+        if pid not in sync_by_pid:
+            fail(f"merged trace {path}: rank {pid} has no dist.clock_sync "
+                 "marker (clock alignment unverifiable)")
+        ts = ts_by_pid.get(pid, [])
+        if any(b < a for a, b in zip(ts, ts[1:])):
+            fail(f"merged trace {path}: rank {pid} timestamps are not "
+                 "monotonic in the merged event order")
+        names = {e["name"] for e in spans_by_pid[pid]}
+        if "dist.solve" not in names:
+            fail(f"merged trace {path}: rank {pid} has no dist.solve span "
+                 f"(got {sorted(names)})")
+    counts = {pid: len(spans_by_pid[pid]) for pid in pids}
+    print(f"check_trace: merged trace ok — {n} ranks, spans per rank "
+          f"{counts}")
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--dist":
+        rest = argv[1:]
+        expect = None
+        if "--ranks" in rest:
+            i = rest.index("--ranks")
+            try:
+                expect = int(rest[i + 1])
+            except (IndexError, ValueError):
+                print("check_trace: --ranks expects an integer",
+                      file=sys.stderr)
+                print(__doc__, file=sys.stderr)
+                return 2
+            del rest[i:i + 2]
+        if len(rest) != 1:
+            print(__doc__, file=sys.stderr)
+            return 2
+        check_dist_trace(rest[0], expect_ranks=expect)
+        print("check_trace: all merged-trace invariants hold")
+        return 0
     if len(argv) != 2:
         print(__doc__, file=sys.stderr)
         return 2

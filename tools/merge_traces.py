@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Merge per-rank cluster traces into one Perfetto-loadable trace.
+
+``python -m dmlp_tpu.distributed --trace DIR`` leaves one
+``trace-rank<NN>.json`` per rank (obs.dist_trace), each with its own clock
+epoch (``time.perf_counter`` is per-process) and its rank as the Perfetto
+``pid``. This tool:
+
+1. loads every rank file in DIR (the rank set must be contiguous 0..N-1
+   and match each file's recorded ``num_ranks`` — a missing rank means a
+   crashed or unstarted process, which the merge must fail on, not paper
+   over);
+2. aligns clocks: every rank stamped a ``dist.clock_sync`` instant
+   immediately after the cluster barrier released it, so shifting each
+   rank's timestamps by (reference sync − its sync) puts all ranks on a
+   common timeline to ~barrier-release accuracy. Rank 0's sync is the
+   reference; per-rank offsets are recorded in the merged ``dist`` block;
+3. cross-checks span counts per rank: every rank must carry spans at all,
+   and the per-rank count of ``dist.solve`` spans (the contract solve —
+   dispatched identically on every rank) must agree across ranks;
+4. writes one merged Chrome-trace JSON, events sorted by aligned ``ts``
+   (per-rank monotonicity is then checkable by tools/check_trace.py
+   --dist), with distinct pids so ui.perfetto.dev renders one process
+   track per rank.
+
+Usage: python tools/merge_traces.py DIR [-o MERGED.json] [--no-align]
+Exit 0 on success; 1 with a message naming the violated invariant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def fail(msg: str):
+    print(f"merge_traces: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def load_rank_files(trace_dir: str):
+    """-> list of (rank, doc), sorted by rank; validates the rank set."""
+    paths = sorted(glob.glob(os.path.join(trace_dir, "trace-rank*.json")))
+    if not paths:
+        fail(f"no trace-rank*.json files in {trace_dir}")
+    docs = []
+    for p in paths:
+        m = re.search(r"trace-rank(\d+)\.json$", p)
+        if not m:
+            continue
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            fail(f"{p} unreadable: {e}")
+        dist = doc.get("dist") or {}
+        rank = dist.get("rank", int(m.group(1)))
+        if rank != int(m.group(1)):
+            fail(f"{p}: embedded rank {rank} != filename rank "
+                 f"{int(m.group(1))}")
+        docs.append((rank, doc))
+    docs.sort()
+    ranks = [r for r, _ in docs]
+    want_n = docs[0][1].get("dist", {}).get("num_ranks", len(docs))
+    if ranks != list(range(want_n)):
+        fail(f"rank set {ranks} is not contiguous 0..{want_n - 1} "
+             "(a rank's trace is missing — crashed or never started?)")
+    return docs
+
+
+def sync_ts(doc, rank: int) -> float:
+    """The rank's barrier-aligned clock-sync timestamp (us)."""
+    ts = (doc.get("dist") or {}).get("clock_sync_ts_us")
+    if ts is not None:
+        return float(ts)
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") == "i" and e.get("name") == "dist.clock_sync":
+            return float(e["ts"])
+    fail(f"rank {rank}: no dist.clock_sync event — was the trace written "
+         "by dmlp_tpu.distributed --trace (obs.dist_trace)?")
+
+
+def merge(trace_dir: str, align: bool = True) -> dict:
+    docs = load_rank_files(trace_dir)
+    offsets = {}
+    if align:
+        ref = sync_ts(docs[0][1], 0)
+        offsets = {rank: ref - sync_ts(doc, rank) for rank, doc in docs}
+
+    events = []
+    span_counts = {}
+    solve_counts = {}
+    for rank, doc in docs:
+        off = offsets.get(rank, 0.0)
+        n_spans = 0
+        for e in doc.get("traceEvents", []):
+            e = dict(e)
+            if "ts" in e:
+                e["ts"] = e["ts"] + off
+            events.append(e)
+            if e.get("ph") == "X":
+                n_spans += 1
+                if e.get("name") == "dist.solve":
+                    solve_counts[rank] = solve_counts.get(rank, 0) + 1
+        span_counts[rank] = n_spans
+        if n_spans == 0:
+            fail(f"rank {rank}: zero spans — tracing was installed but "
+                 "nothing recorded")
+    if len(set(solve_counts.get(r, 0) for r, _ in docs)) > 1:
+        fail(f"per-rank dist.solve span counts disagree: {solve_counts} "
+             "(every rank runs the same contract solve; a mismatch means "
+             "a rank died mid-run or traced a different program)")
+
+    # Rebase so the merged timeline starts at 0: alignment shifts a
+    # rank's pre-barrier events negative relative to the reference
+    # rank's epoch, and downstream consumers (check_trace --dist) hold
+    # timestamps non-negative.
+    stamped = [e["ts"] for e in events if "ts" in e]
+    base = min(stamped) if stamped else 0.0
+    if base < 0:
+        for e in events:
+            if "ts" in e:
+                e["ts"] -= base
+    # Stable sort by aligned ts, metadata (M) events first per pid so
+    # Perfetto names tracks before their first slice arrives.
+    events.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
+                               e.get("ts", 0.0)))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "dist": {
+            "num_ranks": len(docs),
+            "aligned": bool(align),
+            "clock_offsets_us": {str(r): offsets.get(r, 0.0)
+                                 for r, _ in docs},
+            "span_counts": {str(r): span_counts[r] for r, _ in docs},
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace_dir", help="directory holding trace-rank*.json")
+    ap.add_argument("-o", "--out", default=None,
+                    help="merged output path (default DIR/trace-merged.json)")
+    ap.add_argument("--no-align", action="store_true",
+                    help="keep each rank's raw clock (skip the "
+                         "clock-sync offset alignment)")
+    args = ap.parse_args(argv)
+
+    out_path = args.out or os.path.join(args.trace_dir, "trace-merged.json")
+    doc = merge(args.trace_dir, align=not args.no_align)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, out_path)
+    d = doc["dist"]
+    print(f"merge_traces: merged {d['num_ranks']} ranks -> {out_path} "
+          f"(spans per rank: {d['span_counts']}, offsets us: "
+          f"{ {k: round(v, 1) for k, v in d['clock_offsets_us'].items()} })")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

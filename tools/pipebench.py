@@ -5,14 +5,16 @@ Runs both dp_pp schedules at fixed shape across small microbatch counts
 (the regime VERDICT r4 item 6 targets: the GPipe bubble term
 (S-1)/(M+S-1) is largest there), interleaved A/B with rotating starts
 (the verify-skill methodology), and records per-config median/min step
-times plus the analytic bubble fractions.
+times plus the analytic bubble fractions — one schema-1 RunRecord
+(obs.run) with the sweep table under metrics, plus per-schedule
+ppermute activation bytes from obs.comms.
 
 Pipeline parallelism needs multiple devices; the container has ONE real
 TPU chip, so this runs on the virtual 8-device CPU mesh (like harness
 config 3) — schedule-relative numbers, not absolute TPU step times.
 
 Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-    python tools/pipebench.py [--out PIPEBENCH_r05.json]
+    python tools/pipebench.py [--out PIPEBENCH_r06.json]
 """
 from __future__ import annotations
 
@@ -70,7 +72,7 @@ def time_steps(state, step, x, y, reps: int):
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="PIPEBENCH_r05.json")
+    ap.add_argument("--out", default="PIPEBENCH_r06.json")
     ap.add_argument("--reps", type=int, default=7)
     ap.add_argument("--hidden", type=int, default=1024)
     ap.add_argument("--dp", type=int, default=2)
@@ -78,6 +80,7 @@ def main() -> int:
     ap.add_argument("--virtual", type=int, default=2)
     args = ap.parse_args()
 
+    from dmlp_tpu.obs.comms import pipeline_ppermute_traffic
     from dmlp_tpu.train.pipeline import (bubble_fraction, make_pp_mesh,
                                          schedule_ticks)
     mesh = make_pp_mesh(args.dp, args.pp)
@@ -102,41 +105,57 @@ def main() -> int:
                "virtual": args.virtual, "hidden": args.hidden,
                "batch": batch}
         for s, ts in samples.items():
+            # Analytic activation-traffic accounting (obs.comms): the
+            # schedule's ppermute bytes per step, fwd + bwd mirrored.
+            ppermute = pipeline_ppermute_traffic(
+                args.pp, n_micro, batch // args.dp // n_micro, args.hidden,
+                schedule=s, n_virtual=args.virtual, n_groups=args.dp,
+                count=2)
             rec[s] = {
                 "median_ms": float(np.median(ts)),
                 "min_ms": float(np.min(ts)),
                 "ticks": schedule_ticks(s, n_micro, args.pp, args.virtual),
                 "bubble_fraction": bubble_fraction(s, n_micro, args.pp,
                                                    args.virtual),
+                "ppermute_bytes_per_step": ppermute.bytes_total,
             }
         rec["interleaved_vs_gpipe_pct"] = 100.0 * (
             rec["interleaved"]["median_ms"] / rec["gpipe"]["median_ms"] - 1)
         records.append(rec)
         print(json.dumps(rec))
 
-    # Two-sweep schema, merged in place per hidden size: re-running the
-    # tool for one sweep must not clobber the other sweeps already in the
-    # artifact (the small-hidden sweep is overhead-dominated, the large
-    # one compute-dominated — both belong in the record).
-    out = {"platform": jax.devices()[0].platform,
-           "n_devices": len(jax.devices()),
-           "note": "virtual CPU mesh (1 real TPU chip cannot host a "
-                   "pipeline); schedule-relative timings + analytic "
-                   "bubble fractions. Small hidden sizes are per-tick-"
-                   "overhead dominated (emulated collectives; interleaved "
-                   "loses); compute-dominated sweeps show the bubble win.",
-           "sweeps": {}}
+    # One schema-1 RunRecord (obs.run), sweeps merged in place per hidden
+    # size: re-running the tool for one sweep must not clobber the other
+    # sweeps already in the artifact (the small-hidden sweep is overhead-
+    # dominated, the large one compute-dominated — both belong).
+    from dmlp_tpu.obs.run import RunRecord
+    sweeps = {}
     if os.path.exists(args.out):
         try:
             prev = json.load(open(args.out))
-            out["sweeps"].update(prev.get("sweeps", {}))
-            if "note" in prev:
-                out["note"] = prev["note"]
+            # RunRecord form nests sweeps under metrics; the grandfathered
+            # pre-migration artifact held them at top level.
+            sweeps.update(prev.get("metrics", prev).get("sweeps", {}))
         except (json.JSONDecodeError, OSError):
             pass
-    out["sweeps"][f"hidden_{args.hidden}"] = records
-    with open(args.out, "w") as f:
-        json.dump(out, f, indent=1)
+    sweeps[f"hidden_{args.hidden}"] = records
+    RunRecord(
+        kind="pipebench", tool="tools/pipebench",
+        config={"platform": jax.devices()[0].platform,
+                "n_devices": len(jax.devices()),
+                "dp": args.dp, "pp": args.pp, "virtual": args.virtual,
+                "reps": args.reps},
+        metrics={
+            "note": "virtual CPU mesh (1 real TPU chip cannot host a "
+                    "pipeline); schedule-relative timings + analytic "
+                    "bubble fractions and ppermute activation bytes "
+                    "(obs.comms). Small hidden sizes are per-tick-"
+                    "overhead dominated (emulated collectives; "
+                    "interleaved loses); compute-dominated sweeps show "
+                    "the bubble win.",
+            "sweeps": sweeps,
+        },
+    ).write(args.out)
     print(f"wrote {args.out}")
     return 0
 

@@ -16,13 +16,15 @@ tunneled link). Floors:
 The gap decomposes into the extraction while-loop (sized by the kernel's
 own iteration diagnostics) + the sort epilogue (timed separately).
 
+Writes one schema-1 RunRecord (obs.run) whose counters block carries
+the analytic kernel cost model (obs.kernel_cost).
+
 Usage (DEFAULT env, real chip): python tools/roofline_extract.py
-    [--out ROOFLINE_r05.json] [--n 204800 --q 10240 --a 64 --k 32]
+    [--out ROOFLINE_r06.json] [--n 204800 --q 10240 --a 64 --k 32]
 """
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 
@@ -35,7 +37,7 @@ HBM_GBPS = {"tpu v5 lite": 819.0, "v5e": 819.0}
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="ROOFLINE_r05.json")
+    ap.add_argument("--out", default="ROOFLINE_r06.json")
     ap.add_argument("--n", type=int, default=204800)
     ap.add_argument("--q", type=int, default=10240)
     ap.add_argument("--a", type=int, default=64)
@@ -164,9 +166,20 @@ def main() -> int:
         f"noise (raw solve {rec['raw_ms']['solve_with_epilogue']} vs "
         f"kernel {rec['raw_ms']['kernel_only']} ms); each dispatch adds "
         f"~{rec['dispatch_overhead_ms']} ms tunnel wall time")
-    with open(args.out, "w") as f:
-        json.dump(rec, f, indent=1)
-    print(json.dumps(rec, indent=1))
+
+    # One schema-1 RunRecord (obs.run); the counters block carries the
+    # analytic kernel model (obs.kernel_cost) — the same numbers the
+    # engine CLI now reports for pallas dispatches on TPU.
+    from dmlp_tpu.obs.kernel_cost import extract_topk_cost
+    from dmlp_tpu.obs.run import RunRecord
+    record = RunRecord(
+        kind="roofline", tool="tools/roofline_extract",
+        config={"device": dev.device_kind, "shape": [n, q, a],
+                "k": args.k, "kc": kc, "reps": args.reps},
+        metrics=rec,
+        counters=extract_topk_cost(qpad, npad, a, kc))
+    record.write(args.out)
+    print(record.to_json())
     return 0
 
 

@@ -7,7 +7,9 @@ SCALE_r03 showed the extraction solve degrading 1.64x from kcap 40 to 136
 {64, 136, 256, 512} x a variant grid over (tile_q, ne, unroll), so the
 engine can pick per-kc tuning instead of one-size-fits-all.
 
-Writes SWEEP_WIDEK_r{N}.jsonl (one JSON line per config). Env:
+Writes SWEEP_WIDEK_r{N}.jsonl: one schema-1 RunRecord (obs.run) per
+line — config carries (kc, variant), metrics the fenced timing — plus a
+final ``kind: "sweep_widek_summary"`` record with best_per_kc. Env:
 BENCH_REPEATS (default 3), BENCH_OUT.
 """
 
@@ -34,7 +36,7 @@ def main() -> int:
         return 1
 
     repeats = _env_int("BENCH_REPEATS", 3)
-    out_path = os.environ.get("BENCH_OUT", "SWEEP_WIDEK_r04.jsonl")
+    out_path = os.environ.get("BENCH_OUT", "SWEEP_WIDEK_r06.jsonl")
     n, nq, na = 204800, 10240, 64
     inp = make_workload(n, nq, na, 32)
     q, d, lab, npad, qpad = stage_extract_inputs(inp)
@@ -54,37 +56,45 @@ def main() -> int:
         variants = json.loads(os.environ["BENCH_VARIANTS"])
 
     from dmlp_tpu.engine.single import round_up
+    from dmlp_tpu.obs.run import RunRecord
 
+    shape = {"num_data": n, "num_queries": nq, "num_attrs": na}
+    if os.path.exists(out_path):
+        os.remove(out_path)  # fresh sweep; append_jsonl accumulates below
     results = []
-    with open(out_path, "w") as f:
-        for kc in kcs:
-            kcp = round_up(kc, 8)
-            for v in variants:
-                def fn(q_, d_):
-                    od, oi, _ = extract_topk(q_, d_, n_real=n, kc=kcp,
-                                             tile_n=BLOCK_ROWS, **v)
-                    return _extract_finalize(od, oi, lab, k=kcp).dists
+    for kc in kcs:
+        kcp = round_up(kc, 8)
+        for v in variants:
+            def fn(q_, d_):
+                od, oi, _ = extract_topk(q_, d_, n_real=n, kc=kcp,
+                                         tile_n=BLOCK_ROWS, **v)
+                return _extract_finalize(od, oi, lab, k=kcp).dists
 
-                try:
-                    t0 = time.perf_counter()
-                    _ = float(fn(q, d)[0, 0])  # compile + fence
-                    compile_s = time.perf_counter() - t0
-                    ms = time_fenced_solve_ms(fn, q, d, repeats)
-                    rec = {"kc": kcp, **v, "ms": round(ms, 1),
+            try:
+                t0 = time.perf_counter()
+                _ = float(fn(q, d)[0, 0])  # compile + fence
+                compile_s = time.perf_counter() - t0
+                metrics = {"ms": round(time_fenced_solve_ms(fn, q, d,
+                                                            repeats), 1),
                            "compile_s": round(compile_s, 1)}
-                except Exception as e:  # noqa: BLE001 — record, keep sweeping
-                    rec = {"kc": kcp, **v, "error": repr(e)[:200]}
-                print(json.dumps(rec), flush=True)
-                f.write(json.dumps(rec) + "\n")
-                f.flush()
-                results.append(rec)
+            except Exception as e:  # noqa: BLE001 — record, keep sweeping
+                metrics = {"error": repr(e)[:200]}
+            rec = {"kc": kcp, **v, **metrics}
+            RunRecord(kind="sweep_widek", tool="tools/sweep_widek",
+                      config={"kc": kcp, "variant": v, "shape": shape,
+                              "repeats": repeats},
+                      metrics=metrics).append_jsonl(out_path)
+            print(json.dumps(rec), flush=True)
+            results.append(rec)
 
     best = {}
     for rec in results:
         if "ms" in rec and rec["ms"] < best.get(rec["kc"], {}).get("ms", 1e18):
             best[rec["kc"]] = rec
-    with open(out_path, "a") as f:
-        f.write(json.dumps({"best_per_kc": best}) + "\n")
+    RunRecord(kind="sweep_widek_summary", tool="tools/sweep_widek",
+              config={"shape": shape, "kcs": kcs},
+              metrics={"best_per_kc": {str(k): v for k, v in best.items()}},
+              ).append_jsonl(out_path)
     print(json.dumps({"best_per_kc": best}))
     return 0
 
