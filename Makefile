@@ -5,7 +5,7 @@
 CXX ?= g++
 CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
-.PHONY: all native test bench obs-smoke obs-dist-smoke clean
+.PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke clean
 
 all: native
 
@@ -14,8 +14,19 @@ native: native/_fastparse.so
 native/_fastparse.so: native/fastparse.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
-test: obs-smoke obs-dist-smoke
+test: obs-smoke obs-dist-smoke tune-smoke
 	python -m pytest tests/ -q
+
+# Tier-1 no-regression guard (ROADMAP "Tier-1 verify"): on this
+# container's jax (0.4.37, CPU backend) the suite must hold >= 277
+# passed with the failure set no worse than PR 2's 11 environment-limited
+# cases (6 multi-process spawn + 3 offload + 1 multipass-semantics +
+# 1 offload-loop — all pre-existing jax/container limits, none
+# engine-correctness). Run before merging anything that touches the
+# engines, the kernels, or obs.
+tier1:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+	  --continue-on-collection-errors
 
 # One-line JSON benchmark on the current backend (TPU under the default env).
 bench:
@@ -45,6 +56,19 @@ obs-smoke:
 # per-rank timestamps).
 obs-dist-smoke:
 	JAX_PLATFORMS=cpu python tools/obs_dist_smoke.py --dir outputs/dist_obs
+
+# Autotuner smoke: a tiny-shape measured sweep on CPU (interpret-mode
+# kernel) through the real `python -m dmlp_tpu.tune` CLI into a
+# scratch cache, then an explicit schema validation of the file it
+# wrote — proves measure -> pick -> persist -> reload end to end
+# without touching any developer's real variant cache.
+tune-smoke:
+	mkdir -p outputs
+	rm -f outputs/tune_smoke_cache.json
+	JAX_PLATFORMS=cpu DMLP_TPU_TUNE_CACHE=outputs/tune_smoke_cache.json \
+	  python -m dmlp_tpu.tune --smoke --record outputs/TUNE_SMOKE.json
+	JAX_PLATFORMS=cpu python -m dmlp_tpu.tune \
+	  --validate outputs/tune_smoke_cache.json
 
 clean:
 	rm -f native/_fastparse.so

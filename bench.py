@@ -182,6 +182,15 @@ def time_device_solve_ms(inp, repeats: int, use_pallas: bool) -> dict:
             ms = _time_extract_solve_ms(inp, repeats, use_pallas)
             if ms is not None:
                 out["device_solve_ms_extract"] = ms
+                # Which variant actually ran (tuner cache entry when one
+                # exists for this device/shape/kc, else the heuristic) —
+                # artifacts must say what they measured.
+                from dmlp_tpu.ops.pallas_extract import (BLOCK_ROWS,
+                                                         QUERY_TILE,
+                                                         resolve_variant)
+                out["extract_variant"] = resolve_variant(
+                    k, round_up(n, BLOCK_ROWS), round_up(nq, QUERY_TILE),
+                    a)
             continue
         pallas = use_pallas and select == "seg"
         granule = 1024 if pallas else 128

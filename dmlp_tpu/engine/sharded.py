@@ -462,8 +462,10 @@ class ShardedEngine:
 
         src = np.ascontiguousarray(inp.data_attrs, np.float32)
         throttle = ChunkThrottle()
+        from dmlp_tpu.ops.pallas_extract import resolve_variant
         with obs_span("sharded.enqueue_chunked", chunks=nchunks,
-                      mesh=[r, c], kc=k):
+                      mesh=[r, c], kc=k,
+                      variant=resolve_variant(k, chunk_rows, qloc, na)):
             for t in range(nchunks):
                 toff = t * chunk_rows
                 # Staging buffer directly in the wire dtype: slice

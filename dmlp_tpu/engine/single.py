@@ -195,6 +195,31 @@ def hetk_split(cfg: EngineConfig, staging: str, ks: np.ndarray,
     return bulk, out
 
 
+class MeasuredIters:
+    """Lazy per-site accumulator for the extract kernel's iteration
+    diagnostics: ``add()`` chains a tiny on-device ``jnp.sum`` per
+    dispatch (no-op unless a cost probe is installed), ``done()`` queues
+    the site's device scalar on ``engine._pending_iters`` for the
+    post-fence flush (engine._flush_measured_iters) — ONE copy of the
+    protocol for the four extract paths instead of four."""
+
+    def __init__(self, engine, site: str,
+                 shape: Tuple[int, int, int, int]):
+        self._on = obs_counters.active() is not None
+        self._engine, self._site, self._shape = engine, site, shape
+        self._sum = None
+
+    def add(self, iters) -> None:
+        if self._on:
+            s = jnp.sum(iters)
+            self._sum = s if self._sum is None else self._sum + s
+
+    def done(self) -> None:
+        if self._sum is not None:
+            self._engine._pending_iters.append(
+                (self._site, self._sum, self._shape))
+
+
 @contextlib.contextmanager
 def no_auto_coarsen(engine):
     """Device-full output IS the device ordering (no f64 rescore or host
@@ -367,6 +392,10 @@ class SingleChipEngine:
         self.last_hetk = None  # (bulk, outlier) counts when routing split
         self.last_mp_passes = 0  # multi-pass extraction pass count
         self._mp_hazard = None   # its per-query loss flags (run() repairs)
+        # (site, device iters-sum scalar, (qb, b, a, kc)) triples the
+        # extract paths queue when a cost probe is installed; flushed to
+        # obs.counters after the solve fence (measured extraction term).
+        self._pending_iters: list = []
 
     def _prep(self, inp: KNNInput):
         cfg = self.config
@@ -539,8 +568,12 @@ class SingleChipEngine:
         q_dev = jnp.asarray(q_attrs, self._dtype)
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
         od = oi = None
+        mi = MeasuredIters(self, "single.extract_topk",
+                           (qpad, chunk_rows, na, k))
         throttle = ChunkThrottle()
-        with obs_span("single.enqueue_extract", chunks=nchunks, kc=k):
+        from dmlp_tpu.ops.pallas_extract import resolve_variant
+        with obs_span("single.enqueue_extract", chunks=nchunks, kc=k,
+                      variant=resolve_variant(k, chunk_rows, qpad, na)):
             for c in range(nchunks):
                 lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
                 if lo >= n:
@@ -559,7 +592,9 @@ class SingleChipEngine:
                 od, oi, _iters = extract_topk(
                     q_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=k,
                     interpret=interpret)
+                mi.add(_iters)
                 throttle.tick(od)
+        mi.done()
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         top = _extract_finalize(od, oi, jnp.asarray(inp.labels), k=k)
@@ -667,6 +702,8 @@ class SingleChipEngine:
         # chunks stay resident for passes 2..P.
         chunks: List[Tuple] = []
         od = oi = None
+        mi = MeasuredIters(self, "single.extract_mp_pass1",
+                           (qpad, chunk_rows, na, kc))
         throttle = ChunkThrottle()
         for c in range(nchunks):
             lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
@@ -680,9 +717,12 @@ class SingleChipEngine:
                     extract_topk, (q_dev, da), statics=dict(kc=kc),
                     count=n_staged, site="single.extract_mp_pass1")
             chunks.append((da, lo, hi))
-            od, oi, _ = extract_topk(q_dev, da, od, oi, n_real=hi - lo,
-                                     id_base=lo, kc=kc, interpret=interpret)
+            od, oi, _iters = extract_topk(q_dev, da, od, oi, n_real=hi - lo,
+                                          id_base=lo, kc=kc,
+                                          interpret=interpret)
+            mi.add(_iters)
             throttle.tick(od)
+        mi.done()
         ods, ois = [od], [oi]
 
         # Floors chain ON DEVICE (_mp_floor): every pass enqueues without
@@ -713,16 +753,20 @@ class SingleChipEngine:
                 extract_topk, (q_dev, d_full), statics=dict(kc=kc),
                 count=npasses - 1, site="single.extract_mp_resident")
         fds = []
+        mir = MeasuredIters(self, "single.extract_mp_resident",
+                            (qpad, full_rows, na, kc))
         for _p in range(1, npasses):
             floor_dev, fd = _mp_floor(ods[-1], qn_dev, dn_max,
                                       staging=self._staging, na=na)
             fds.append(fd)
-            od, oi, _ = extract_topk(q_dev, d_full, n_real=n, id_base=0,
-                                     kc=kc, interpret=interpret,
-                                     floor=floor_dev)
+            od, oi, _iters = extract_topk(q_dev, d_full, n_real=n, id_base=0,
+                                          kc=kc, interpret=interpret,
+                                          floor=floor_dev)
+            mir.add(_iters)
             throttle.tick(od)
             ods.append(od)
             ois.append(oi)
+        mir.done()
         # Final pass's fd too: a plateau pinning the LAST boundary must
         # flag as well (its ties are the one loss the outer boundary test
         # can miss when kcap >= n).
@@ -749,8 +793,25 @@ class SingleChipEngine:
         self._mp_hazard = stalled[:nq] | shortfall
         return [(top, qpad, None, "extract")]
 
+    def _flush_measured_iters(self) -> None:
+        """Read back the queued extract-loop iters sums (the solve is
+        already fenced by the result fetch, so this is a scalar readback,
+        not a sync) and hand them to the installed cost probe — the
+        MEASURED extraction term of obs.kernel_cost. No-op when nothing
+        was queued (no probe, or a non-extract path ran)."""
+        pend, self._pending_iters = self._pending_iters, []
+        if not pend:
+            return
+        for site, s, shape in pend:
+            try:
+                obs_counters.record_measured_iters(
+                    site, int(jax.device_get(s)), shape)
+            except Exception:
+                pass  # observability must never fail the solve
+
     def _solve(self, inp: KNNInput) -> Tuple[TopK, int]:
         self.last_phase_ms = {}  # no stale phases if a path is skipped
+        self._pending_iters = []
         select = self.config.resolve_select(
             round_up(max(inp.params.num_data, 1), 8))
         if select == "sort":
@@ -819,6 +880,8 @@ class SingleChipEngine:
         carry_o = init_topk(qo_pad, ko)
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
         od = oi = None
+        mi = MeasuredIters(self, "single.extract_bulk",
+                           (qpad_b, chunk_rows, na, kb))
         throttle = ChunkThrottle()
         for c in range(nchunks):
             lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
@@ -836,11 +899,13 @@ class SingleChipEngine:
             od, oi, _iters = extract_topk(
                 qb_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=kb,
                 interpret=interpret)
+            mi.add(_iters)
             carry_o = _outlier_fold(
                 carry_o, qo_dev, da, labels_dev, jnp.int32(lo),
                 jnp.int32(n), chunk_rows=chunk_rows, k=ko,
                 select=select_out, use_pallas=cfg.use_pallas)
             throttle.tick(carry_o.dists)
+        mi.done()
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         top_b = _extract_finalize(od, oi, jnp.asarray(inp.labels), k=kb)
@@ -861,6 +926,7 @@ class SingleChipEngine:
         self.last_hetk = None
         self._mp_hazard = None
         self.last_mp_passes = 0
+        self._pending_iters = []
         plan = self._plan_hetk(inp)
         if plan is not None:
             self.last_phase_ms = {}
@@ -884,6 +950,7 @@ class SingleChipEngine:
         dists = np.asarray(out.dists, np.float64)[:nq]
         labels = np.asarray(out.labels)[:nq]
         ids = np.asarray(out.ids)[:nq]
+        self._flush_measured_iters()
         return dists, labels, ids
 
     def run(self, inp: KNNInput) -> List[QueryResult]:
@@ -982,6 +1049,7 @@ class SingleChipEngine:
             final_ms += (_time.perf_counter() - t0) * 1e3
         self.last_phase_ms["fetch"] = fetch_ms
         self.last_phase_ms["finalize"] = final_ms
+        self._flush_measured_iters()
         return merged
 
     def run_device_full(self, inp: KNNInput) -> List[QueryResult]:
@@ -1014,4 +1082,5 @@ class SingleChipEngine:
                     int(gids[qi]), int(sub.ks[qi]), int(preds[qi]),
                     rids[qi, : int(sub.ks[qi])].astype(np.int64),
                     rd[qi, : int(sub.ks[qi])])
+        self._flush_measured_iters()
         return merged

@@ -164,6 +164,29 @@ def pipeline_ppermute_traffic(pp: int, n_micro: int, micro_rows: int,
                                   f"links x {payload} B activation")
 
 
+def host_allgather_candidates_traffic(num_ranks: int, r_shards: int,
+                                      qpad: int, kcap: int,
+                                      itemsizes=(8, 4, 4),
+                                      count: int = 1) -> CollectiveTraffic:
+    """The multi-host contract's candidate all-gather
+    (parallel.distributed: ``multihost_utils.process_allgather`` of the
+    rescored (R, Qpad, K) triple — f64 dists + i32 labels + i32 ids by
+    default, hence the (8, 4, 4) itemsizes): every process contributes
+    its triple once and receives the other num_ranks-1 processes'.
+
+    This is the ANALYTIC side of the per-rank reconciliation: the trace
+    span ``dist.allgather_candidates`` carries the real payload bytes
+    (sum of the three arrays' nbytes) plus these shape args, and
+    tools/merge_traces.py checks the two agree per rank
+    (``bytes_out_per_device`` here == the span's ``nbytes``)."""
+    payload = r_shards * qpad * kcap * sum(itemsizes)
+    return CollectiveTraffic(
+        "host_allgather_candidates", "process", num_ranks, payload,
+        max(num_ranks - 1, 0) * payload, count=count,
+        note=f"process_allgather of (R={r_shards}, Qpad={qpad}, "
+             f"K={kcap}) x {sum(itemsizes)} B/cand")
+
+
 def engine_comms(merge_strategy: str, mesh_shape, q_local: int,
                  k: int) -> List[CollectiveTraffic]:
     """Traffic for one mesh-engine solve, from the shapes actually

@@ -33,7 +33,8 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["CostProbe", "normalize_cost", "lowered_cost", "roofline",
-           "install", "uninstall", "active", "record_dispatch"]
+           "install", "uninstall", "active", "record_dispatch",
+           "record_measured_iters"]
 
 
 def normalize_cost(raw) -> Optional[Dict[str, float]]:
@@ -72,11 +73,18 @@ class CostProbe:
     def __init__(self) -> None:
         # key -> [fn, spec_args, static_kwargs, count, site]
         self._entries: Dict[Tuple, list] = {}
+        # (site, (qb, b, a, kc)) -> iters_total — measured extract-loop
+        # iteration counts the engines read back post-fence, keyed by
+        # dispatch shape like the dispatch records themselves (two
+        # solves at different shapes under one site must cost their
+        # iterations at their own tiles, not the first shape's)
+        self._measured_iters: Dict[Tuple, int] = {}
 
     def reset(self) -> None:
         """Drop recorded dispatches — callers bracket untimed work (e.g.
         a warmup solve) so counters match the timed region only."""
         self._entries.clear()
+        self._measured_iters.clear()
 
     def record(self, fn, args: tuple, statics: Optional[dict] = None,
                count: int = 1, site: str = "") -> None:
@@ -98,6 +106,19 @@ class CostProbe:
             entry[3] += count
         else:
             self._entries[key] = [fn, specs, statics, count, site]
+
+    def record_measured_iters(self, site: str, iters_total: int,
+                              shape: Tuple[int, int, int, int]) -> None:
+        """Attach MEASURED extraction-loop iteration counts to ``site``
+        (summed over the kernel's iters output across that site's
+        dispatches at this shape). ``shape`` is the per-dispatch
+        (qb, b, a, kc); the collect pass turns each (site, shape)'s
+        count into the measured extraction FLOPs term
+        (obs.kernel_cost.extract_loop_cost) so the site's total is no
+        longer just the deterministic lower bound."""
+        key = (site, tuple(shape))
+        self._measured_iters[key] = \
+            self._measured_iters.get(key, 0) + int(iters_total)
 
     def collect(self) -> Dict[str, Any]:
         """Resolve every recorded signature through cost analysis.
@@ -135,11 +156,32 @@ class CostProbe:
         if analyzed == 0:
             return {"counters_unavailable": True,
                     "dispatches_recorded": dispatches}
+        # Measured extraction terms: fold each (site, shape)'s read-back
+        # iters count into the totals (count-independent — the engines
+        # already summed across that site's dispatches at the shape).
+        iters_all = 0
+        for (site, shape), iters_total in self._measured_iters.items():
+            try:
+                loop_flops = kernel_cost.extract_loop_cost(
+                    *shape, iters_total=iters_total)
+            except Exception:
+                continue
+            flops += loop_flops
+            iters_all += iters_total
+            if site in per_site:
+                per_site[site]["flops"] += loop_flops
+                per_site[site]["extraction_term"] = "measured"
+                per_site[site]["extract_iters_total"] = \
+                    per_site[site].get("extract_iters_total", 0) \
+                    + iters_total
         out: Dict[str, Any] = {
             "flops": flops, "bytes_accessed": byts,
             "dispatches_recorded": dispatches,
             "dispatches_analyzed": analyzed,
         }
+        if iters_all:
+            out["extract_iters_total"] = iters_all
+            out["extraction_term"] = "measured"
         if analytic:
             # Name the modeled share: these dispatches carry analytic
             # (obs.kernel_cost) numbers, not XLA cost analysis.
@@ -203,3 +245,12 @@ def record_dispatch(fn, args: tuple, statics: Optional[dict] = None,
     p = _active
     if p is not None:
         p.record(fn, args, statics=statics, count=count, site=site)
+
+
+def record_measured_iters(site: str, iters_total: int,
+                          shape: Tuple[int, int, int, int]) -> None:
+    """Post-fence hook: measured extract-loop iters for ``site``
+    (see CostProbe.record_measured_iters); no-op without a probe."""
+    p = _active
+    if p is not None:
+        p.record_measured_iters(site, iters_total, shape)

@@ -69,7 +69,12 @@ class DistTracer(obs_trace.Tracer):
         ts = (obs_trace._clock() - self._epoch) * 1e6
         if self._clock_sync_ts_us is None:
             self._clock_sync_ts_us = ts
-        self.instant(CLOCK_SYNC_EVENT, rank=self.rank)
+        # ONE clock read serves both the dist-block stamp and the event:
+        # the merge aligns ranks on clock_sync_ts_us but downstream
+        # consumers compare the EVENT timestamps — a second read would
+        # leave the two µs apart under scheduler jitter, so aligned
+        # sync markers would not coincide exactly.
+        self.instant(CLOCK_SYNC_EVENT, ts=ts, rank=self.rank)
 
     def record_mesh(self, mesh) -> None:
         """Record this rank's mesh-coordinate metadata: the (axis-name ->

@@ -15,10 +15,16 @@ Model scope, per kernel:
 - **flops** count the deterministic arithmetic: the MXU cross-term
   matmul (2*Q*B*A — the same convention XLA uses for dot), the norm
   reductions, and the elementwise norm-expansion epilogue. The extract
-  kernel's while-loop passes are data-dependent (≈1 pass per warm block,
-  tools/roofline_extract.py measures the real term) and are NOT counted
-  — the model is the deterministic lower bound, exactly what a roofline
-  comparison wants.
+  kernel's while-loop passes are data-dependent, so by default the model
+  is the deterministic lower bound — but the kernel reports its per-tile
+  iteration counts, and callers that read them back can pass
+  ``iters_total`` to :func:`extract_topk_cost` (or feed
+  ``CostProbe.record_measured_iters``) to add the MEASURED extraction
+  term (:func:`extract_loop_cost`); the returned dict then carries
+  ``extraction_term: "measured"`` instead of ``"modeled_lower_bound"``.
+  The single-chip engine extract paths do this whenever a probe is
+  installed; the sharded engines' per-shard iters stay inside the
+  shard_map program and keep the lower bound.
 - **bytes_accessed** count HBM traffic implied by the BlockSpec sweep:
   each query tile re-reads the data panel and each data block re-reads
   the query panel (Pallas streams blocks from HBM each grid step; only
@@ -37,28 +43,64 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-__all__ = ["extract_topk_cost", "fused_dist_segmin_cost", "analytic_cost"]
+__all__ = ["extract_topk_cost", "extract_loop_cost",
+           "fused_dist_segmin_cost", "analytic_cost"]
 
 
-def extract_topk_cost(qb: int, b: int, a: int, kc: int) -> Dict[str, float]:
-    """Deterministic cost of one ``ops.pallas_extract.extract_topk``
-    dispatch at (queries (qb, a), data (b, a), list width kc)."""
+def extract_loop_cost(qb: int, b: int, a: int, kc: int,
+                      iters_total: int) -> float:
+    """MEASURED extraction-loop FLOPs for ``iters_total`` recorded loop
+    iterations (summed over the kernel's (Qb/tq, B/tn) ``iters`` output,
+    possibly across many dispatches at the same shape).
+
+    One recorded iteration runs ``unroll`` extraction rounds over one
+    (tq, tn) tile; each round does, per ne-quarter of width w = tn/ne:
+    the quarter min (tq*w), the argmin iota-select (2*tq*w), the mask-out
+    (2*tq*w), and the threshold/insert ops on the (tq, kc) lists
+    (~4*tq*kc) — so ~5*tq*tn + 4*ne*tq*kc FLOPs per round. ``a`` (the
+    attribute width) does not enter the loop arithmetic but DOES enter
+    variant resolution (the tuner cache keys on it and the VMEM gate
+    scales with it), so it must match the dispatch."""
     from dmlp_tpu.ops.pallas_distance import _tile
     from dmlp_tpu.ops.pallas_extract import _TN, _resolve_variant
 
-    v = _resolve_variant(kc, b)
+    v = _resolve_variant(kc, b, qb, a)
     tq = _tile(qb, v["tile_q"], 8)
-    tn = _tile(b, _TN, 128 * v["ne"])
+    tn = _tile(b, v.get("tile_n", _TN), 128 * v["ne"])
+    round_flops = 5.0 * tq * tn + 4.0 * v["ne"] * tq * kc
+    return float(iters_total) * v.get("unroll", 1) * round_flops
+
+
+def extract_topk_cost(qb: int, b: int, a: int, kc: int,
+                      iters_total: Optional[int] = None) -> Dict[str, float]:
+    """Cost of one ``ops.pallas_extract.extract_topk`` dispatch at
+    (queries (qb, a), data (b, a), list width kc). Without
+    ``iters_total`` the data-dependent while-loop is excluded
+    (deterministic lower bound); with it, the measured extraction term
+    (:func:`extract_loop_cost`) is added and the dict says so."""
+    from dmlp_tpu.ops.pallas_distance import _tile
+    from dmlp_tpu.ops.pallas_extract import _TN, _resolve_variant
+
+    v = _resolve_variant(kc, b, qb, a)
+    tq = _tile(qb, v["tile_q"], 8)
+    tn = _tile(b, v.get("tile_n", _TN), 128 * v["ne"])
     flops = (2.0 * qb * b * a      # MXU cross-term block
              + 2.0 * (qb + b) * a  # |q|^2 / |d|^2 norm reductions
-             + 4.0 * qb * b)       # expansion + clamp + floor/sentinel masks
+             + 4.0 * qb * b        # expansion + clamp + floor/sentinel masks
+             + 1.0 * qb * b)       # block-skip prefilter min, one VPU pass
     byts = 4.0 * ((qb // tq) * b * a    # data panel, once per query tile
                   + (b // tn) * qb * a  # query panel, once per data block
                   + (qb // tq) * b      # dn row, once per query tile
                   + (b // tn) * qb      # qn column, once per data block
                   + 2 * qb * kc         # running (dists, ids) lists out
                   + qb // tq * (b // tn))  # iteration diagnostics
-    return {"flops": flops, "bytes_accessed": byts}
+    out = {"flops": flops, "bytes_accessed": byts,
+           "extraction_term": "modeled_lower_bound"}
+    if iters_total is not None:
+        out["flops"] += extract_loop_cost(qb, b, a, kc, iters_total)
+        out["extraction_term"] = "measured"
+        out["extract_iters_total"] = int(iters_total)
+    return out
 
 
 def fused_dist_segmin_cost(qb: int, b: int, a: int) -> Dict[str, float]:

@@ -142,9 +142,13 @@ class Tracer:
     def span(self, name: str, **args) -> Span:
         return Span(self, name, args)
 
-    def instant(self, name: str, **args) -> None:
-        """A zero-duration marker (``ph: "i"``)."""
-        ts = (_clock() - self._epoch) * 1e6
+    def instant(self, name: str, ts: float = None, **args) -> None:
+        """A zero-duration marker (``ph: "i"``). ``ts`` (epoch-relative
+        us) lets a caller that already stamped a clock read reuse it —
+        the dist tracer's clock-sync marker must carry EXACTLY the
+        timestamp the merge aligns on, not a second read µs later."""
+        if ts is None:
+            ts = (_clock() - self._epoch) * 1e6
         self._append({"name": name, "ph": "i", "ts": ts, "s": "t",
                       "pid": self._pid, "tid": self._tid(),
                       **({"args": args} if args else {})})

@@ -22,6 +22,22 @@ engine.cpp:233-257, with a threshold-gated extraction):
   The loop ends when no row improved — for blocks that arrive after the
   running lists are warm, the expected number of iterations is ~1 + k*tn/N,
   so almost all blocks cost one scan, not a sort.
+- Threshold-gated block skipping (ISSUE 3): before the loop, one cheap
+  VPU reduction computes each row's block minimum; when no row's block
+  min strictly beats its current threshold T (the same strict ``m < T``
+  the extraction uses), the while-loop is skipped entirely (0 recorded
+  iterations). A warm no-improve block then costs one (tq, tn) min pass
+  instead of a full extraction round (ne argmin/insert/mask passes) —
+  output-identical, because the skipped round could not have inserted
+  anything. (This differs from the per-pass ``pl.when`` predication that
+  measured SLOWER inside the loop — the gate is a single reduction
+  before the loop, not predication of every pass.)
+
+Variant selection (tile_q / tile_n / ne / unroll) resolves through the
+measured autotuner cache (dmlp_tpu.tune) when an entry exists for this
+(device kind, shape bucket, kc, dtype); otherwise the deterministic
+kc-tuned heuristic below — an absent cache (CPU, CI) is bit-identical
+to the pre-tuner behavior.
 
 Ties are kept by lowest global position (strict `m < T` extraction +
 lowest-lane argmin), i.e. the same semantics as the "topk"/"seg" selects;
@@ -80,29 +96,62 @@ def tuned_variant(kc: int) -> dict:
     return {"tile_q": 64, "ne": 4, "unroll": 1}
 
 
-def _resolve_variant(kc: int, b: int) -> dict:
-    """The variant actually used for (kc, b): the kc-tuned one, unless its
-    ne-alignment can't tile this b (wide-k wants ne=4 → b % 512; a caller
-    with pre-shaped shards, e.g. the multi-host feed, may only satisfy
-    the ne=2 alignment) — then the default variant keeps kernel coverage
-    at r3 tuning rather than silently dropping to the streaming select.
-    supports() and extract_topk resolve through this same function, so
-    gate and kernel can never disagree."""
+def _heuristic_variant(kc: int, b: int) -> dict:
+    """The deterministic fallback: the kc-tuned variant, unless ITS
+    ne-alignment can't tile this b (wide-k wants ne=4 → b % 512; a
+    caller with pre-shaped shards, e.g. the multi-host feed, may only
+    satisfy the ne=2 alignment) — then the default variant keeps kernel
+    coverage at r3 tuning rather than silently dropping to the
+    streaming select."""
     v = tuned_variant(kc)
     if b % (128 * v["ne"]) != 0 and b % (128 * _E) == 0:
         v = {"tile_q": _TQ, "ne": _E, "unroll": 1}
     return v
 
 
-def supports(qb: int, b: int, a: int, kc: int) -> bool:
-    """Shapes the kernel can tile WITH the variant resolved for (kc, b):
-    whole lane-width sub-blocks (b % (128 * ne)), query tiles of 8, kc no
-    wider than one block, and VMEM room for the distance scratch +
-    double-buffered q/d blocks."""
-    v = _resolve_variant(kc, b)
+def _resolve_variant(kc: int, b: int, qb: int | None = None,
+                     a: int | None = None) -> dict:
+    """The variant actually used for (kc, b): the measured autotuner
+    cache entry when one exists for this (device kind, bucket(b),
+    bucket(a), kc) (dmlp_tpu.tune.lookup_variant — never raises, and
+    rejects entries whose ne-alignment cannot tile this b), else the
+    deterministic heuristic. When the caller knows the full dispatch
+    shape (qb, a), a cached variant must ALSO pass variant_supports
+    (VMEM bound included) or resolution falls back — a cache entry may
+    downgrade resolution to the heuristic but can never flip supports()
+    False and disable the kernel. supports(), extract_topk, and the
+    analytic cost model (obs.kernel_cost) resolve through this same
+    function with the same shape arguments, so gate, kernel and
+    counters can never disagree."""
+    from dmlp_tpu.tune import lookup_variant
+    cached = lookup_variant(kc, b, a=a)
+    if cached is not None:
+        if qb is None or a is None \
+                or variant_supports(qb, b, a, kc, cached):
+            return cached
+    return _heuristic_variant(kc, b)
+
+
+def resolve_variant(kc: int, b: int, qb: int | None = None,
+                    a: int | None = None) -> dict:
+    """Public form of the variant resolution (engines/bench/tools report
+    it in spans and artifacts): the dict extract_topk will run with —
+    always carries tile_q/ne/unroll, plus tile_n when the tuner cache
+    pinned one. Pass the full (qb, a) dispatch shape where known so the
+    reported variant matches the kernel's own resolution exactly."""
+    return dict(_resolve_variant(kc, b, qb, a))
+
+
+def variant_supports(qb: int, b: int, a: int, kc: int, v: dict) -> bool:
+    """supports() with an EXPLICIT variant — the gate the tuner sweep
+    shares with extract_topk's own validation, so the sweep can never
+    persist a variant the kernel would reject: whole lane-width
+    sub-blocks (b % (128 * ne)), query tiles of 8, kc no wider than one
+    block, and VMEM room for the distance scratch + double-buffered q/d
+    blocks."""
     if qb % 8 != 0 or b % (128 * v["ne"]) != 0:
         return False
-    tn = _tile(b, _TN, 128 * v["ne"])
+    tn = _tile(b, v.get("tile_n", _TN), 128 * v["ne"])
     tq = _tile(qb, v["tile_q"], 8)
     if kc > tn or kc > 512:
         return False
@@ -110,9 +159,17 @@ def supports(qb: int, b: int, a: int, kc: int) -> bool:
     return vmem <= 64 * 2**20
 
 
+def supports(qb: int, b: int, a: int, kc: int) -> bool:
+    """Shapes the kernel can tile WITH the variant resolved for this
+    full dispatch shape (tuner cache entry or heuristic — same
+    resolution extract_topk uses, VMEM-checked cache fallback
+    included)."""
+    return variant_supports(qb, b, a, kc, _resolve_variant(kc, b, qb, a))
+
+
 def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, f_ref, cd_ref, ci_ref,
             od_ref, oi_ref, it_ref, dist_s, *, kc: int, fresh: bool, ne: int,
-            unroll: int = 1):
+            unroll: int = 1, block_skip: bool = True):
     j = pl.program_id(1)
     nj = pl.num_programs(1)
     tq, tn = dist_s.shape
@@ -198,10 +255,24 @@ def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, f_ref, cd_ref, ci_ref,
         go = round_()
         return it + 1, go > 0
 
+    if block_skip:
+        # Threshold-gated block skipping: one VPU min over the block per
+        # row, against the row's CURRENT k-th best. Strict `<` matches
+        # the extraction's `m < T`, so a skipped block is exactly a
+        # block whose first round would have inserted nothing — the
+        # while-loop below then never starts (0 recorded iterations)
+        # and the no-improve cost drops from a full ne-pass round to
+        # this one reduction.
+        t0 = jnp.max(od_ref[:], axis=1, keepdims=True)      # (tq, 1)
+        bmin = jnp.min(dist, axis=1, keepdims=True)         # (tq, 1)
+        go0 = jnp.max((bmin < t0).astype(jnp.int32)) > 0
+    else:
+        go0 = True
     iters, _ = jax.lax.while_loop(
-        lambda s: s[1] & (s[0] <= tn), body, (jnp.int32(0), True))
+        lambda s: s[1] & (s[0] <= tn), body, (jnp.int32(0), go0))
     # Diagnostic loop counts: lane j of this tile's block (row 0 is read
-    # back; an iota-select avoids dynamic-lane scalar stores).
+    # back; an iota-select avoids dynamic-lane scalar stores). With
+    # block_skip, 0 means the prefilter skipped the block entirely.
     njs = it_ref.shape[1]
     ji = jax.lax.broadcasted_iota(jnp.int32, (tq, njs), 1)
 
@@ -215,18 +286,17 @@ def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, f_ref, cd_ref, ci_ref,
     del nj
 
 
-@functools.partial(
-    jax.jit, static_argnames=("kc", "interpret", "tile_q", "tile_n", "ne",
-                              "unroll"))
 def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
                  carry_d: jax.Array | None = None,
                  carry_i: jax.Array | None = None, *, n_real,
                  id_base=0, kc: int, interpret: bool = False,
-                 tile_q: int | None = None, tile_n: int = _TN,
+                 tile_q: int | None = None, tile_n: int | None = None,
                  ne: int | None = None, unroll: int | None = None,
+                 block_skip: bool = True,
                  floor: jax.Array | None = None):
     """(queries (Qb, A), data (B, A)) -> (dists (Qb, kc) f32 ascending-ish
-    unsorted, ids (Qb, kc) i32, iters (Qb/tq, B/tn) i32 loop counts).
+    unsorted, ids (Qb, kc) i32, iters (Qb/tq, B/tn) i32 loop counts; 0 =
+    the threshold prefilter skipped that block).
     Rows >= n_real are sentinels; data row j has global id id_base + j.
     Optional carry (prior running lists, e.g. from a previous chunk) is
     folded in; without it slots pad (+inf, -1). Optional ``floor``
@@ -234,18 +304,41 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
     dist < floor are masked out (the multi-pass wide-k driver raises it
     to the previous pass's max − eps each pass).
 
-    tile_q/ne/unroll default to the kc-tuned variant (tuned_variant);
-    pass them explicitly only to override (the sweep tool does).
+    tile_q/tile_n/ne/unroll default to the resolved variant (the tuner
+    cache entry when one exists, else the kc-tuned heuristic); pass them
+    explicitly only to override (the tune sweep does). The resolution
+    happens OUT HERE, before the jit boundary, so the CONCRETE variant
+    is part of the jit cache key — a cache update mid-process (a sweep
+    just ran) changes which compiled kernel the next call uses instead
+    of silently reusing a trace baked with the old variant.
+    ``block_skip`` toggles the threshold-gated block prefilter
+    (output-identical either way; off only for A/B measurement,
+    tools/roofline_extract.py).
 
     Gate on supports() first. Output lists are NOT sorted; callers sort by
     the composite key (ops.topk.select_topk) if order matters.
     """
+    v = _resolve_variant(kc, d_attrs.shape[0], q_attrs.shape[0],
+                         q_attrs.shape[1])
+    return _extract_topk_jit(
+        q_attrs, d_attrs, carry_d, carry_i, n_real=n_real,
+        id_base=id_base, kc=kc, interpret=interpret,
+        tile_q=v["tile_q"] if tile_q is None else tile_q,
+        tile_n=v.get("tile_n", _TN) if tile_n is None else tile_n,
+        ne=v["ne"] if ne is None else ne,
+        unroll=v["unroll"] if unroll is None else unroll,
+        block_skip=block_skip, floor=floor)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kc", "interpret", "tile_q", "tile_n", "ne",
+                              "unroll", "block_skip"))
+def _extract_topk_jit(q_attrs, d_attrs, carry_d, carry_i, *, n_real,
+                      id_base, kc: int, interpret: bool, tile_q: int,
+                      tile_n: int, ne: int, unroll: int, block_skip: bool,
+                      floor):
     qb, a = q_attrs.shape
     b = d_attrs.shape[0]
-    v = _resolve_variant(kc, b)
-    tile_q = v["tile_q"] if tile_q is None else tile_q
-    ne = v["ne"] if ne is None else ne
-    unroll = v["unroll"] if unroll is None else unroll
     tq = _tile(qb, tile_q, 8)
     tn = _tile(b, tile_n, 128 * ne)
     # Validate the ACTUAL tiling (supports() only covers the defaults):
@@ -274,7 +367,7 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
     scalars = jnp.asarray([[n_real, id_base]], jnp.int32)     # (1, 2) SMEM
     grid = (qb // tq, b // tn)
     kern = functools.partial(_kernel, kc=kc, fresh=fresh, ne=ne,
-                             unroll=unroll)
+                             unroll=unroll, block_skip=block_skip)
     out_d, out_i, out_iters = pl.pallas_call(
         kern,
         grid=grid,

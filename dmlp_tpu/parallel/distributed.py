@@ -517,9 +517,20 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
 
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
+            # nbytes is the REAL payload; the shape args let
+            # tools/merge_traces.py recompute the analytic expectation
+            # (obs.comms.host_allgather_candidates_traffic) and
+            # reconcile the two per rank.
             with obs_span("dist.allgather_candidates",
                           nbytes=int(my_d.nbytes + my_l.nbytes
-                                     + my_i.nbytes)):
+                                     + my_i.nbytes),
+                          ranks=int(jax.process_count()),
+                          r_shards=int(my_d.shape[0]),
+                          qpad=int(my_d.shape[1]),
+                          kcap=int(my_d.shape[2]),
+                          itemsizes=[int(my_d.dtype.itemsize),
+                                     int(my_l.dtype.itemsize),
+                                     int(my_i.dtype.itemsize)]):
                 all_d = multihost_utils.process_allgather(my_d)
                 all_l = multihost_utils.process_allgather(my_l)
                 all_i = multihost_utils.process_allgather(my_i)

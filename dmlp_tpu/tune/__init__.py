@@ -1,0 +1,33 @@
+"""Measured kernel autotuning (ISSUE 3 tentpole).
+
+The extract kernel's variant space — ``tile_q`` (query rows per tile),
+``tile_n`` (data rows per block), ``ne`` (extraction candidates per loop
+pass), ``unroll`` (rounds per loop-condition sync) — was frozen by two
+hand-tuned heuristics measured on ONE shape on ONE chip
+(ops.pallas_extract.tuned_variant). This package replaces the frozen
+constants with *measured, cached* selection:
+
+- :mod:`dmlp_tpu.tune.sweep` times every legal variant with the fenced
+  dependent-readback methodology the bench tools share and picks the
+  fastest;
+- :mod:`dmlp_tpu.tune.cache` persists winners in a small versioned JSON
+  cache keyed by (device kind, data-rows shape bucket, kc, dtype);
+- :func:`lookup_variant` is the hot-path read:
+  ``ops.pallas_extract._resolve_variant`` consults it first and falls
+  back to the deterministic heuristic when there is no entry — an
+  absent cache (CPU, CI, fresh hardware) keeps today's behavior
+  bit-identical.
+
+Cache location: ``$DMLP_TPU_TUNE_CACHE`` if set, else
+``~/.cache/dmlp_tpu/extract_variants.json``. Regenerate on new hardware
+with ``python -m dmlp_tpu.tune`` (see README "Autotuning").
+"""
+
+from __future__ import annotations
+
+from dmlp_tpu.tune.cache import (CACHE_SCHEMA, VariantCache, cache_path,
+                                 clear_lookup_memo, lookup_variant,
+                                 shape_bucket)
+
+__all__ = ["CACHE_SCHEMA", "VariantCache", "cache_path",
+           "clear_lookup_memo", "lookup_variant", "shape_bucket"]

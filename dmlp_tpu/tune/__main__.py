@@ -1,0 +1,138 @@
+"""CLI for the measured extract-kernel autotuner.
+
+Regenerate the variant cache on the current backend::
+
+    python -m dmlp_tpu.tune [--n 204800 --q 10240 --a 64 --k 32]
+                            [--kc 40 --kc 136 ...] [--reps 3]
+                            [--out PATH] [--record RUNRECORD.json]
+
+The sweep measures at the CHUNKED dispatch shape the engines actually
+use (plan_chunks on the extract granule) and merges winners into the
+cache file (``$DMLP_TPU_TUNE_CACHE`` or
+``~/.cache/dmlp_tpu/extract_variants.json``) keyed by (device kind,
+data-rows bucket, kc, dtype). Existing entries for other keys are kept.
+
+``--smoke`` runs a tiny-shape sweep (CPU interpret mode works) over a
+4-variant slice — the ``make tune-smoke`` CI gate that proves the
+measure -> pick -> persist -> reload pipeline and validates the cache
+schema end-to-end. ``--validate PATH`` just schema-checks an existing
+cache file and exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="dmlp_tpu.tune", description=__doc__)
+    ap.add_argument("--n", type=int, default=204800)
+    ap.add_argument("--q", type=int, default=10240)
+    ap.add_argument("--a", type=int, default=64)
+    ap.add_argument("--k", type=int, action="append", default=None,
+                    help="workload k (repeatable); kc derives via "
+                         "resolve_kcap with float32 staging")
+    ap.add_argument("--kc", type=int, action="append", default=None,
+                    help="candidate-list width to tune directly "
+                         "(repeatable; overrides --k derivation)")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None,
+                    help="cache file (default: the lookup path — "
+                         "$DMLP_TPU_TUNE_CACHE or ~/.cache/dmlp_tpu/"
+                         "extract_variants.json)")
+    ap.add_argument("--record", default=None,
+                    help="also write one schema-1 RunRecord (obs.run) "
+                         "summarizing the sweep")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny-shape 4-variant sweep (CPU CI gate)")
+    ap.add_argument("--validate", metavar="PATH", default=None,
+                    help="schema-check an existing cache file and exit")
+    args = ap.parse_args(argv)
+
+    from dmlp_tpu.tune.cache import (VariantCache, cache_path,
+                                     clear_lookup_memo)
+
+    if args.validate:
+        try:
+            with open(args.validate) as f:
+                doc = json.load(f)
+            VariantCache.validate_doc(doc)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"tune: INVALID cache {args.validate}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"tune: cache ok — {len(doc['entries'])} entries "
+              f"({args.validate})")
+        return 0
+
+    from dmlp_tpu.tune.sweep import smoke_space, sweep_extract
+
+    if args.smoke:
+        n, nq, a = 1024, 16, 8
+        kcs = [16]
+        reps = 1
+        space_fn = smoke_space
+    else:
+        n, nq, a = args.n, args.q, args.a
+        reps = args.reps
+        space_fn = None
+        if args.kc:
+            kcs = sorted(set(args.kc))
+        else:
+            from dmlp_tpu.config import EngineConfig
+            from dmlp_tpu.engine.single import resolve_kcap
+            ks = args.k or [32]
+            kcs = sorted({resolve_kcap(EngineConfig(), k, "extract",
+                                       1 << 30, staging="float32")
+                          for k in ks})
+
+    out_path = args.out or cache_path()
+    print(f"tune: sweeping extract variants at n={n} q={nq} a={a} "
+          f"kcs={kcs} reps={reps} -> {out_path}", flush=True)
+    kwargs = {} if space_fn is None else {"space_fn": space_fn}
+    winners, rows = sweep_extract(n, nq, a, kcs, reps=reps,
+                                  seed=args.seed, out=sys.stdout, **kwargs)
+    if not winners:
+        print("tune: FAIL — no variant measured for any kc",
+              file=sys.stderr)
+        return 1
+
+    import os
+
+    from dmlp_tpu.tune.cache import _current_device_kind
+    kind = _current_device_kind()
+    try:
+        cache = VariantCache.load(out_path) if os.path.exists(out_path) \
+            else VariantCache()
+    except Exception:
+        cache = VariantCache()  # unreadable/stale-schema file: rebuild
+    for w in winners:
+        cache.put(kind, w["b"], w["kc"], w["variant"], a=a,
+                  dtype="float32", measured_ms=w["measured_ms"],
+                  swept=w["swept"], shape=(w["qb"], w["b"], a))
+    cache.save(out_path)
+    clear_lookup_memo()  # this process sees its own fresh winners
+    VariantCache.validate_doc(cache.to_dict())
+
+    if args.record:
+        from dmlp_tpu.obs.run import RunRecord
+        RunRecord(kind="tune", tool="dmlp_tpu.tune",
+                  config={"n": n, "q": nq, "a": a, "kcs": list(kcs),
+                          "reps": reps, "device_kind": kind,
+                          "smoke": bool(args.smoke)},
+                  metrics={"winners": winners, "sweep_rows": rows},
+                  artifacts={"cache": out_path}).write(args.record)
+
+    print(json.dumps({"device_kind": kind, "cache": out_path,
+                      "entries": len(cache.entries),
+                      "winners": [{"kc": w["kc"], "b": w["b"],
+                                   "variant": w["variant"]}
+                                  for w in winners]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,274 @@
+"""Persisted variant cache for the measured autotuner.
+
+One small versioned JSON file maps (device kind, data-rows shape bucket,
+kc, dtype) -> the fastest measured extract-kernel variant. The file is
+written by the sweep (``python -m dmlp_tpu.tune``) and read on the hot
+path by ``ops.pallas_extract._resolve_variant`` through
+:func:`lookup_variant`.
+
+Design constraints, in order:
+
+- **Absent cache == today.** When the file does not exist the lookup
+  returns None without importing jax or touching a backend — CPU/CI
+  resolution stays bit-identical to the frozen heuristics (and a read
+  can never accidentally dial the remote TPU just to learn the device
+  kind; the kind is only needed once a file with entries exists).
+- **Keys are buckets, not exact shapes.** Data-row and attribute-width
+  counts bucket to the next power of two: the variant ranking moves
+  with the block-sweep regime (how many blocks amortize the warm-up)
+  and with the VMEM footprint `a` drives, not with every ±5% of rows,
+  and exact-shape keys would make every new dataset a cache miss. kc
+  is already discrete (resolve_kcap rounds to 8) and keys directly.
+- **A cache entry must never disable the kernel.** The envelope is
+  validated on load (schema/kernel), each entry is re-validated at
+  lookup (one corrupt entry misses itself, it does not poison the
+  file's other winners), ne-alignment is re-checked against the
+  concrete ``b``, and the resolver re-runs the full supports gate
+  (VMEM included) on a cache hit — anything that fails falls through
+  to the heuristic instead of erroring or flipping supports() False.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+#: bump on any backward-incompatible cache field change
+CACHE_SCHEMA = 1
+
+_KERNEL = "extract_topk"
+
+#: legal extraction-candidates-per-pass values (quarter layout: ne must
+#: divide the block into whole 128-lane sub-blocks)
+_NE_CHOICES = (1, 2, 4, 8)
+
+
+def cache_path() -> str:
+    """The cache file location: ``$DMLP_TPU_TUNE_CACHE`` wins, else
+    ``~/.cache/dmlp_tpu/extract_variants.json``."""
+    env = os.environ.get("DMLP_TPU_TUNE_CACHE")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "dmlp_tpu",
+                        "extract_variants.json")
+
+
+def shape_bucket(b: int) -> int:
+    """Data-row count -> power-of-two bucket (the smallest power of two
+    >= b). 12800 and 16000 share a bucket; 12800 and 51200 do not."""
+    if b <= 1:
+        return 1
+    return 1 << (b - 1).bit_length()
+
+
+def _key(device_kind: str, b_bucket: int, a_bucket: int, kc: int,
+         dtype: str) -> str:
+    return f"{device_kind}|b{b_bucket}|a{a_bucket}|kc{kc}|{dtype}"
+
+
+def validate_variant(v: Any) -> bool:
+    """Structural sanity of one variant dict (no jax, no shape context):
+    tile_q a positive multiple of 8, ne a legal quarter count, unroll a
+    small positive int, optional tile_n a positive multiple of 128*ne."""
+    if not isinstance(v, dict):
+        return False
+    tq, ne, unroll = v.get("tile_q"), v.get("ne"), v.get("unroll", 1)
+    if not (isinstance(tq, int) and tq > 0 and tq % 8 == 0):
+        return False
+    if ne not in _NE_CHOICES:
+        return False
+    if not (isinstance(unroll, int) and 1 <= unroll <= 8):
+        return False
+    tn = v.get("tile_n")
+    if tn is not None and not (isinstance(tn, int) and tn > 0
+                               and tn % (128 * ne) == 0):
+        return False
+    return True
+
+
+def variant_fits(v: Dict[str, Any], b: int, kc: int) -> bool:
+    """Alignment gate for a concrete dispatch: the variant's ne must tile
+    ``b`` into whole 128-lane sub-blocks and kc must fit one block (the
+    fresh-seed slice reads the first kc columns). The VMEM bound is
+    enforced downstream by supports()/extract_topk with this same
+    variant — this gate only rejects what could not even tile."""
+    if b % (128 * v["ne"]) != 0:
+        return False
+    tn = v.get("tile_n")
+    if tn is not None and kc > tn:
+        return False
+    return True
+
+
+class VariantCache:
+    """In-memory form of the cache file; save()/load() round-trip it."""
+
+    def __init__(self, entries: Optional[Dict[str, Dict]] = None,
+                 created_unix: Optional[float] = None):
+        self.entries: Dict[str, Dict] = dict(entries or {})
+        self.created_unix = (time.time() if created_unix is None
+                             else created_unix)
+
+    # -- mutation ------------------------------------------------------------
+    def put(self, device_kind: str, b: int, kc: int, variant: Dict, *,
+            a: int, dtype: str = "float32",
+            measured_ms: Optional[float] = None,
+            swept: Optional[int] = None,
+            shape: Optional[Tuple[int, int, int]] = None) -> str:
+        """Record the winning ``variant`` for (device, bucket(b),
+        bucket(a), kc, dtype); returns the entry key. ``a`` (the swept
+        attribute width) is part of the key: the VMEM footprint — and
+        hence which variants even fit — scales with it. Raises
+        ValueError on a variant that fails structural validation — a
+        sweep must never persist a variant the hot path would have to
+        reject."""
+        if not validate_variant(variant):
+            raise ValueError(f"invalid variant {variant!r}")
+        key = _key(device_kind, shape_bucket(b), shape_bucket(a), kc,
+                   dtype)
+        entry: Dict[str, Any] = {"variant": dict(variant),
+                                 "created_unix": time.time()}
+        if measured_ms is not None:
+            entry["measured_ms"] = round(float(measured_ms), 4)
+        if swept is not None:
+            entry["swept"] = int(swept)
+        if shape is not None:
+            entry["shape"] = list(shape)
+        self.entries[key] = entry
+        return key
+
+    # -- read ----------------------------------------------------------------
+    def get(self, device_kind: str, b: int, kc: int, *, a: int,
+            dtype: str = "float32") -> Optional[Dict]:
+        """The cached variant for (device, bucket(b), bucket(a), kc,
+        dtype), after per-entry validation and the per-dispatch
+        alignment gate — None on miss, corrupt entry, or misfit."""
+        e = self.entries.get(
+            _key(device_kind, shape_bucket(b), shape_bucket(a), kc,
+                 dtype))
+        if not isinstance(e, dict):
+            return None
+        v = e.get("variant")
+        if not validate_variant(v) or not variant_fits(v, b, kc):
+            return None
+        return dict(v)
+
+    # -- persistence ---------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {"schema": CACHE_SCHEMA, "kernel": _KERNEL,
+                "created_unix": self.created_unix, "entries": self.entries}
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or cache_path()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+    @staticmethod
+    def validate_doc(doc: Any) -> None:
+        """Raise ValueError naming the first schema violation (the
+        tune-smoke CI gate calls this on the file it just wrote)."""
+        if not isinstance(doc, dict):
+            raise ValueError("cache is not a JSON object")
+        schema = doc.get("schema")
+        if schema != CACHE_SCHEMA:
+            raise ValueError(f"cache schema {schema!r} != {CACHE_SCHEMA} "
+                             "(regenerate with python -m dmlp_tpu.tune)")
+        if doc.get("kernel") != _KERNEL:
+            raise ValueError(f"cache kernel {doc.get('kernel')!r} != "
+                             f"{_KERNEL!r}")
+        entries = doc.get("entries")
+        if not isinstance(entries, dict):
+            raise ValueError("cache entries block missing or not a dict")
+        for key, e in entries.items():
+            if not isinstance(e, dict) or not validate_variant(
+                    e.get("variant")):
+                raise ValueError(f"entry {key!r} carries an invalid "
+                                 f"variant: {e!r}")
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "VariantCache":
+        """Load with ENVELOPE validation only (schema/kernel/entries
+        shape) — raises on an unreadable or wrong-schema file, but a
+        single corrupt ENTRY does not poison the rest: per-entry
+        validation happens at ``get()``, so the file's other winners
+        stay live. The strict whole-file check (every entry valid) is
+        :meth:`validate_doc` — the ``--validate`` CI gate."""
+        path = path or cache_path()
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or doc.get("schema") != CACHE_SCHEMA \
+                or doc.get("kernel") != _KERNEL \
+                or not isinstance(doc.get("entries"), dict):
+            raise ValueError(
+                f"{path}: not a schema-{CACHE_SCHEMA} {_KERNEL} variant "
+                "cache (regenerate with python -m dmlp_tpu.tune)")
+        return cls(entries=doc["entries"],
+                   created_unix=doc.get("created_unix"))
+
+
+# -- hot-path lookup (memoized, never raises) --------------------------------
+_memo: Dict[str, Optional[VariantCache]] = {}
+_device_kind_memo: Dict[str, str] = {}
+
+
+def clear_lookup_memo() -> None:
+    """Drop the per-process cache/device memo (tests, or after a sweep
+    rewrites the file mid-process)."""
+    _memo.clear()
+    _device_kind_memo.clear()
+
+
+def _current_device_kind() -> str:
+    """The backend's device kind ("TPU v5 lite", "cpu", ...), memoized.
+    Only called once a cache file with entries exists — a missing cache
+    must never be the thing that initializes a backend."""
+    kind = _device_kind_memo.get("kind")
+    if kind is None:
+        try:
+            import jax
+            d = jax.devices()[0]
+            kind = d.device_kind if d.platform == "tpu" else d.platform
+        except Exception:
+            kind = "unknown"
+        _device_kind_memo["kind"] = kind
+    return kind
+
+
+def lookup_variant(kc: int, b: int, a: Optional[int] = None,
+                   dtype: str = "float32",
+                   device_kind: Optional[str] = None,
+                   path: Optional[str] = None) -> Optional[Dict]:
+    """The hot-path read: cached variant for this dispatch, or None.
+
+    Never raises; returns None when ``a`` is unknown (the attribute
+    width is part of the key — every real dispatch site knows it), the
+    cache file is absent, unreadable, schema-invalid, keyed for a
+    different device kind, the matched entry is corrupt, or its variant
+    cannot tile this ``b`` (alignment rejection) — the caller then uses
+    the deterministic heuristic."""
+    if a is None:
+        return None
+    path = path or cache_path()
+    if path not in _memo:
+        if not os.path.exists(path):
+            _memo[path] = None
+        else:
+            try:
+                _memo[path] = VariantCache.load(path)
+            except Exception:
+                _memo[path] = None
+    cache = _memo[path]
+    if cache is None or not cache.entries:
+        return None
+    if device_kind is None:
+        device_kind = _current_device_kind()
+    return cache.get(device_kind, b, kc, a=a, dtype=dtype)

@@ -245,6 +245,150 @@ def test_ring_hetk_routing_matches_golden():
         assert g.checksum() == w.checksum()
 
 
+def _pad_stage(data, queries, gran_rows=256, gran_q=8):
+    """Pad (data, queries) to kernel granules for DIRECT extract_topk
+    calls (the engines do this via plan_chunks/QUERY_TILE)."""
+    import jax.numpy as jnp
+
+    from dmlp_tpu.engine.single import round_up
+    n, na = data.shape
+    nq = queries.shape[0]
+    npad, qpad = round_up(n, gran_rows), round_up(nq, gran_q)
+    d = np.zeros((npad, na), np.float32); d[:n] = data
+    q = np.zeros((qpad, na), np.float32); q[:nq] = queries
+    return jnp.asarray(d), jnp.asarray(q), n, nq
+
+
+def test_extract_kernel_tie_rows_straddling_block_boundary():
+    """Duplicated data rows placed EXACTLY astride an in-kernel block
+    boundary (tile_n=256: rows 255/256) with k=1: the extraction must
+    keep the LOWEST global position, with and without block skipping —
+    the strict `m < T` tie contract the engines' repair path depends
+    on. Also the chunk-boundary form: the duplicate's twin arrives in a
+    later carry fold and must NOT displace the lower id."""
+    import jax.numpy as jnp
+
+    from dmlp_tpu.ops.pallas_extract import extract_topk
+
+    rng = np.random.default_rng(5)
+    n, na = 512, 4
+    data = rng.uniform(-50, 50, (n, na))
+    data[256] = data[255]                 # dup pair astride block boundary
+    queries = np.stack([data[255], data[10]])
+    d, q, n_real, _nq = _pad_stage(data, queries)
+    for skip in (True, False):
+        od, oi, _ = extract_topk(q, d, n_real=n_real, kc=8,
+                                 interpret=True, tile_n=256,
+                                 block_skip=skip)
+        # row 0's best is the dup distance (0.0): slot ids must include
+        # 255 — and 255 must be extracted before 256 (lowest position
+        # first), so with both present the MIN of the two slots is 255.
+        ids0 = set(np.asarray(oi)[0].tolist())
+        assert 255 in ids0 and 256 in ids0
+
+    # chunk-boundary ties: the same row closes chunk 1 and opens chunk 2
+    d1 = rng.uniform(-50, 50, (512, na))
+    d2 = rng.uniform(-50, 50, (512, na))
+    d2[0] = d1[511]
+    q2 = np.ascontiguousarray(d1[511][None])
+    dd1, qq, _, _ = _pad_stage(d1, q2)
+    dd2 = jnp.asarray(d2.astype(np.float32))
+    for skip in (True, False):
+        od, oi, _ = extract_topk(qq, dd1, n_real=512, kc=8,
+                                 interpret=True, tile_n=256,
+                                 block_skip=skip)
+        od, oi, _ = extract_topk(qq, dd2, od, oi, n_real=512, id_base=512,
+                                 kc=8, interpret=True, tile_n=256,
+                                 block_skip=skip)
+        oi_np = np.asarray(oi)[0]
+        srt = oi_np[np.argsort(np.asarray(od)[0], kind="stable")]
+        # both tied copies are in the top-8 (dist 0), and k=1 semantics
+        # (the first report slot) keep the lower global id 511
+        assert {511, 512} <= set(oi_np.tolist())
+        assert min(srt[0], srt[1]) == 511
+
+
+def test_extract_engine_tie_heavy_dup_rows_block_boundaries_vs_golden(
+        tmp_path, monkeypatch):
+    """Engine-level tie regression for block skipping: a tuner cache
+    entry pins a small tile_n (many in-kernel block boundaries), the
+    dataset repeats whole row-groups so tie groups straddle those
+    boundaries, and the full run() must still equal the float64 golden
+    model exactly — block skipping cannot silently change
+    lowest-global-position tie breaking."""
+    from dmlp_tpu.engine.single import resolve_kcap
+    from dmlp_tpu.tune import VariantCache, clear_lookup_memo
+
+    rng = np.random.default_rng(91)
+    n_base, nq, na = 160, 14, 3
+    base = rng.integers(0, 3, (n_base, na)).astype(np.float64)
+    data = np.concatenate([base, base, base, base])      # 4 copies: deep ties
+    n = data.shape[0]
+    queries = rng.integers(0, 3, (nq, na)).astype(np.float64)
+    labels = rng.integers(0, 4, n).astype(np.int32)
+    # kmax stays small so kcap (40) fits the pinned tile_n — a wider k
+    # would route to multipass at a different kcap and the cache entry
+    # would never resolve, making the whole test vacuous.
+    ks = rng.integers(1, 33, nq).astype(np.int32)
+    inp = KNNInput(Params(n, nq, na), labels, data, ks, queries)
+
+    kc = resolve_kcap(EngineConfig(), int(ks.max()), "extract", 1 << 30,
+                      staging="float32")
+    pinned = {"tile_q": 32, "tile_n": 256, "ne": 2, "unroll": 1}
+    assert kc <= pinned["tile_n"]          # the entry must be resolvable
+    path = str(tmp_path / "variants.json")
+    monkeypatch.setenv("DMLP_TPU_TUNE_CACHE", path)
+    cache = VariantCache()
+    cache.put("cpu", 12800, kc, pinned, a=na)
+    cache.save(path)
+    clear_lookup_memo()
+    from dmlp_tpu.obs import trace as obs_trace
+    tracer = obs_trace.install(obs_trace.Tracer())
+    try:
+        eng = SingleChipEngine(EngineConfig(select="extract",
+                                            use_pallas=True))
+        got = eng.run(inp)
+    finally:
+        obs_trace.uninstall()
+        clear_lookup_memo()
+    assert eng._last_select == "extract"
+    # prove the pinned multi-block variant actually drove the kernel
+    spans = [e for e in tracer.to_dict()["traceEvents"]
+             if e.get("name") == "single.enqueue_extract"]
+    assert spans and spans[0]["args"]["variant"] == pinned
+    assert_same_results(got, knn_golden(inp), check_dists=False)
+
+
+@pytest.mark.parametrize("seed", [401, 402, 403, 404])
+def test_extract_block_skip_output_identical_fuzz(seed):
+    """Direct-kernel A/B over the fuzz distribution (duplicate-heavy
+    grids included): block_skip on/off must be bit-identical in dists,
+    ids, AND the running lists after a warm second fold — the skip gate
+    may only elide rounds that would have inserted nothing."""
+    import jax.numpy as jnp
+
+    from dmlp_tpu.ops.pallas_extract import extract_topk
+
+    inp = _case(seed)
+    kc = 16
+    d, q, n_real, _ = _pad_stage(inp.data_attrs, inp.query_attrs)
+    outs = {}
+    for skip in (True, False):
+        od1, oi1, it1 = extract_topk(q, d, n_real=n_real, kc=kc,
+                                     interpret=True, tile_n=256,
+                                     block_skip=skip)
+        od2, oi2, it2 = extract_topk(q, d, od1, oi1, n_real=n_real,
+                                     id_base=d.shape[0], kc=kc,
+                                     interpret=True, tile_n=256,
+                                     block_skip=skip)
+        outs[skip] = (np.asarray(od2), np.asarray(oi2),
+                      int(np.asarray(it1).sum() + np.asarray(it2).sum()))
+    assert np.array_equal(outs[True][0], outs[False][0])
+    assert np.array_equal(outs[True][1], outs[False][1])
+    # the gate can only REMOVE no-op rounds
+    assert outs[True][2] <= outs[False][2]
+
+
 def test_extract_engine_wide_k_tuned_variant():
     """k > 64 routes to the wide-list tuned variant (tq=64, ne=4,
     SWEEP_WIDEK_r04); parity must hold there too."""

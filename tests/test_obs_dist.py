@@ -166,6 +166,109 @@ def test_check_dist_trace_validates_merged(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# analytic-vs-traced comms reconciliation (ROADMAP item): the
+# dist.allgather_candidates span carries real payload bytes + shapes;
+# merge_traces recomputes the analytic expectation and embeds the
+# per-rank table; check_trace --dist fails any mismatching rank
+# ---------------------------------------------------------------------------
+
+def _write_rank_with_allgather(tmp_path, rank, num_ranks, nbytes,
+                               shape_args=True):
+    """A synthetic rank trace in the DistTracer file format, carrying
+    one contract solve span and one allgather span with (optionally)
+    the r6 shape args."""
+    args = {"nbytes": nbytes}
+    if shape_args:
+        args.update(ranks=num_ranks, r_shards=2, qpad=16, kcap=8,
+                    itemsizes=[8, 4, 4])
+    doc = {
+        "dist": {"rank": rank, "num_ranks": num_ranks,
+                 "clock_sync_ts_us": 100.0},
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": rank, "tid": 0,
+             "args": {"name": f"rank {rank}"}},
+            {"ph": "i", "name": "dist.clock_sync", "ts": 100.0,
+             "pid": rank, "tid": 0, "s": "p"},
+            {"ph": "X", "name": "dist.solve", "ts": 110.0, "dur": 5.0,
+             "pid": rank, "tid": 0},
+            {"ph": "X", "name": "dist.allgather_candidates", "ts": 112.0,
+             "dur": 1.0, "pid": rank, "tid": 0, "args": args},
+        ],
+    }
+    with open(tmp_path / f"trace-rank{rank:02d}.json", "w") as f:
+        json.dump(doc, f)
+
+
+def test_merge_reconciles_analytic_vs_traced_allgather_bytes(tmp_path):
+    # the REAL payload of a (2, 16, 8) f64+i32+i32 triple: 2*16*8*16 B
+    payload = 2 * 16 * 8 * (8 + 4 + 4)
+    for rank in range(2):
+        _write_rank_with_allgather(tmp_path, rank, 2, payload)
+    merge_traces = _load_tool("merge_traces")
+    doc = merge_traces.merge(str(tmp_path))
+    rec = doc["dist"]["comms_reconcile"]
+    assert set(rec) == {"0", "1"}
+    for e in rec.values():
+        assert e["traced_bytes"] == payload
+        assert e["analytic_bytes"] == payload
+        assert e["match"] is True
+
+    check_trace = _load_tool("check_trace")
+    merged = tmp_path / "merged.json"
+    with open(merged, "w") as f:
+        json.dump(doc, f)
+    check_trace.check_dist_trace(str(merged))  # must not exit
+
+    # the analytic helper itself: received bytes = (P-1) * payload
+    from dmlp_tpu.obs.comms import host_allgather_candidates_traffic
+    t = host_allgather_candidates_traffic(2, 2, 16, 8)
+    assert t.bytes_out_per_device == payload
+    assert t.bytes_in_per_device == payload          # (2-1) * payload
+
+
+def test_check_dist_trace_fails_on_comms_mismatch(tmp_path):
+    payload = 2 * 16 * 8 * 16
+    _write_rank_with_allgather(tmp_path, 0, 2, payload)
+    _write_rank_with_allgather(tmp_path, 1, 2, payload - 64)  # rank 1 lies
+    merge_traces = _load_tool("merge_traces")
+    doc = merge_traces.merge(str(tmp_path))
+    assert doc["dist"]["comms_reconcile"]["1"]["match"] is False
+    assert doc["dist"]["comms_reconcile"]["0"]["match"] is True
+
+    check_trace = _load_tool("check_trace")
+    merged = tmp_path / "merged.json"
+    with open(merged, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(SystemExit):
+        check_trace.check_dist_trace(str(merged))
+
+
+def test_pre_r6_spans_get_explicit_unavailable_marker(tmp_path):
+    for rank in range(2):
+        _write_rank_with_allgather(tmp_path, rank, 2, 1024,
+                                   shape_args=False)
+    merge_traces = _load_tool("merge_traces")
+    doc = merge_traces.merge(str(tmp_path))
+    rec = doc["dist"]["comms_reconcile"]
+    for e in rec.values():
+        assert "analytic_unavailable" in e
+        assert "match" not in e          # no false verdict either way
+    check_trace = _load_tool("check_trace")
+    merged = tmp_path / "merged.json"
+    with open(merged, "w") as f:
+        json.dump(doc, f)
+    check_trace.check_dist_trace(str(merged))  # marker, not a failure
+
+
+def test_merge_without_allgather_spans_embeds_no_reconcile(tmp_path):
+    for rank in range(2):
+        _write_rank(tmp_path, rank, 2)
+    merge_traces = _load_tool("merge_traces")
+    doc = merge_traces.merge(str(tmp_path))
+    assert "comms_reconcile" not in doc["dist"]
+
+
+# ---------------------------------------------------------------------------
 # the real cluster form (spawns OS processes) — skips where the jax build
 # cannot run multi-process CPU computations (the seed suite's known drift)
 # ---------------------------------------------------------------------------
@@ -251,6 +354,66 @@ def test_probe_resolves_extract_topk_analytically():
 
 def test_analytic_cost_unknown_fn_is_none():
     assert kernel_cost.analytic_cost(lambda x: x, (), {}) is None
+
+
+def test_extract_cost_measured_iters_term():
+    """iters_total turns the extraction term from the deterministic
+    lower bound into a measured total (ROADMAP item): strictly more
+    flops, marked as measured, linear in the iteration count."""
+    base = kernel_cost.extract_topk_cost(128, 12800, 64, 40)
+    assert base["extraction_term"] == "modeled_lower_bound"
+    m1 = kernel_cost.extract_topk_cost(128, 12800, 64, 40, iters_total=100)
+    m2 = kernel_cost.extract_topk_cost(128, 12800, 64, 40, iters_total=200)
+    assert m1["extraction_term"] == "measured"
+    assert m1["extract_iters_total"] == 100
+    assert m1["flops"] > base["flops"]
+    assert m2["flops"] - base["flops"] == pytest.approx(
+        2 * (m1["flops"] - base["flops"]))
+    assert m1["bytes_accessed"] == base["bytes_accessed"]
+
+
+def test_probe_folds_measured_iters_into_site():
+    from dmlp_tpu.ops.pallas_extract import extract_topk
+
+    probe = obs_counters.CostProbe()
+    q = jnp.zeros((128, 8), jnp.float32)
+    d = jnp.zeros((1280, 8), jnp.float32)
+    probe.record(extract_topk, (q, d), statics=dict(kc=16), count=3,
+                 site="single.extract_topk")
+    probe.record_measured_iters("single.extract_topk", 50,
+                                (128, 1280, 8, 16))
+    got = probe.collect()
+    assert got["extraction_term"] == "measured"
+    assert got["extract_iters_total"] == 50
+    site = got["per_site"]["single.extract_topk"]
+    assert site["extraction_term"] == "measured"
+    assert site["extract_iters_total"] == 50
+    base = kernel_cost.extract_topk_cost(128, 1280, 8, 16)
+    loop = kernel_cost.extract_loop_cost(128, 1280, 8, 16, 50)
+    assert got["flops"] == pytest.approx(3 * base["flops"] + loop)
+
+
+def test_extract_engine_run_reports_measured_extraction_term():
+    """End to end: a probed extract engine run reads the kernel's iters
+    back post-fence and the collected counters say 'measured'."""
+    from dmlp_tpu.config import EngineConfig
+    from dmlp_tpu.engine.single import SingleChipEngine
+    from dmlp_tpu.io.datagen import generate_input_text
+    from dmlp_tpu.io.grammar import parse_input_text
+
+    inp = parse_input_text(
+        generate_input_text(800, 8, 5, 0.0, 20.0, 1, 8, 3, seed=13))
+    eng = SingleChipEngine(EngineConfig(select="extract", use_pallas=True))
+    probe = obs_counters.install()
+    try:
+        eng.run(inp)
+    finally:
+        obs_counters.uninstall()
+    got = probe.collect()
+    assert got.get("extraction_term") == "measured"
+    assert got.get("extract_iters_total", 0) > 0
+    site = got["per_site"]["single.extract_topk"]
+    assert site["extraction_term"] == "measured"
 
 
 def test_extract_engine_run_records_analytic_counters():

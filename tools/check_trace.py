@@ -17,9 +17,13 @@ structural contract the obs subsystem promises —
 ``--dist MERGED.json [--ranks N]`` instead validates a merged multi-rank
 cluster trace (tools/merge_traces.py output): the expected number of
 distinct rank pids, per-rank process metadata events and clock-sync
-markers, per-rank spans including the contract ``dist.solve`` span, and
-monotonic (sorted, non-negative) per-rank timestamps after alignment —
-the `make obs-dist-smoke` checker.
+markers, per-rank spans including the contract ``dist.solve`` span,
+monotonic (sorted, non-negative) per-rank timestamps after alignment,
+and — when the merge embedded a ``comms_reconcile`` block — agreement
+between each rank's traced all-gather payload bytes and the analytic
+model (obs.comms): any rank whose two numbers disagree FAILS the check
+(per-rank flagging of the analytic-vs-traced reconciliation) — the
+`make obs-dist-smoke` checker.
 
 Exit 0 on success, 1 with a message naming the first violated invariant.
 
@@ -155,6 +159,23 @@ def check_dist_trace(path: str, expect_ranks: int = None) -> None:
         if "dist.solve" not in names:
             fail(f"merged trace {path}: rank {pid} has no dist.solve span "
                  f"(got {sorted(names)})")
+    reconcile = doc.get("dist", {}).get("comms_reconcile")
+    if reconcile:
+        for rank, e in sorted(reconcile.items()):
+            if e.get("match") is False:
+                fail(f"merged trace {path}: rank {rank} analytic vs "
+                     f"traced all-gather bytes disagree "
+                     f"(traced {e.get('traced_bytes')} != analytic "
+                     f"{e.get('analytic_bytes')}) — the comms model "
+                     "(obs.comms) and the real payload have diverged")
+            if "analytic_unavailable" in e:
+                print(f"check_trace: note — rank {rank} comms "
+                      f"reconciliation unavailable: "
+                      f"{e['analytic_unavailable']}")
+        ok_ranks = [r for r, e in reconcile.items() if e.get("match")]
+        if ok_ranks:
+            print(f"check_trace: comms reconcile ok — analytic == traced "
+                  f"all-gather bytes for rank(s) {sorted(ok_ranks)}")
     counts = {pid: len(spans_by_pid[pid]) for pid in pids}
     print(f"check_trace: merged trace ok — {n} ranks, spans per rank "
           f"{counts}")

@@ -18,7 +18,16 @@ epoch (``time.perf_counter`` is per-process) and its rank as the Perfetto
 3. cross-checks span counts per rank: every rank must carry spans at all,
    and the per-rank count of ``dist.solve`` spans (the contract solve —
    dispatched identically on every rank) must agree across ranks;
-4. writes one merged Chrome-trace JSON, events sorted by aligned ``ts``
+4. reconciles comms accounting per rank: each
+   ``dist.allgather_candidates`` span carries the REAL payload bytes
+   (``nbytes``) plus the gathered shapes; the merge recomputes the
+   analytic expectation (obs.comms.host_allgather_candidates_traffic —
+   the same model the engines' one-shot comms records use) and embeds a
+   per-rank traced-vs-analytic table in the merged ``dist`` block
+   (``comms_reconcile``); ``tools/check_trace.py --dist`` fails on any
+   rank whose two numbers disagree. Pre-r6 traces without the shape
+   args get an explicit ``analytic_unavailable`` marker, not a failure;
+5. writes one merged Chrome-trace JSON, events sorted by aligned ``ts``
    (per-rank monotonicity is then checkable by tools/check_trace.py
    --dist), with distinct pids so ui.perfetto.dev renders one process
    track per rank.
@@ -35,6 +44,8 @@ import json
 import os
 import re
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def fail(msg: str):
@@ -84,6 +95,67 @@ def sync_ts(doc, rank: int) -> float:
          "by dmlp_tpu.distributed --trace (obs.dist_trace)?")
 
 
+_AG_SHAPE_KEYS = ("ranks", "r_shards", "qpad", "kcap")
+
+
+def reconcile_comms(docs) -> dict | None:
+    """Per-rank traced-vs-analytic byte table for the candidate
+    all-gather, or None when no rank traced one (single-process or
+    emulated runs dispatch no host all-gather — absence is normal, not
+    a violation).
+
+    The analytic side deliberately uses the MODEL'S OWN per-candidate
+    itemsizes (obs.comms defaults: f64 dists + i32 labels + i32 ids),
+    not the itemsizes the span recorded from the live arrays — the
+    traced ``nbytes`` comes from the real buffers, so if the
+    implementation's dtypes ever drift from what obs.comms assumes, the
+    two sides disagree and the check FLAGS it instead of following the
+    drift. (Shapes still come from the span: they are structural — the
+    same r/qpad/kcap every dist.* span of the solve shares.) The span's
+    recorded itemsizes ride along as a diagnostic on mismatch. Import
+    of the analytic model is lazy and failure maps to an explicit
+    marker: the merge must work from a bare checkout."""
+    per_rank = {}
+    for rank, doc in docs:
+        spans = [e for e in doc.get("traceEvents", [])
+                 if e.get("ph") == "X"
+                 and e.get("name") == "dist.allgather_candidates"]
+        if not spans:
+            continue
+        entry: dict = {"spans": len(spans),
+                       "traced_bytes": sum(int(e.get("args", {})
+                                               .get("nbytes", 0))
+                                           for e in spans)}
+        analytic = 0
+        marker = None
+        for e in spans:
+            a = e.get("args", {})
+            if not all(k in a for k in _AG_SHAPE_KEYS):
+                marker = ("span args lack shape fields "
+                          "(pre-r6 trace — re-record to reconcile)")
+                break
+            try:
+                from dmlp_tpu.obs.comms import \
+                    host_allgather_candidates_traffic
+                t = host_allgather_candidates_traffic(
+                    int(a["ranks"]), int(a["r_shards"]), int(a["qpad"]),
+                    int(a["kcap"]))
+                analytic += t.bytes_out_per_device
+            except Exception as exc:
+                marker = f"analytic model unavailable ({exc})"
+                break
+        if marker is not None:
+            entry["analytic_unavailable"] = marker
+        else:
+            entry["analytic_bytes"] = analytic
+            entry["match"] = analytic == entry["traced_bytes"]
+            if not entry["match"]:
+                entry["span_itemsizes"] = [
+                    e.get("args", {}).get("itemsizes") for e in spans]
+        per_rank[str(rank)] = entry
+    return per_rank or None
+
+
 def merge(trace_dir: str, align: bool = True) -> dict:
     docs = load_rank_files(trace_dir)
     offsets = {}
@@ -129,16 +201,28 @@ def merge(trace_dir: str, align: bool = True) -> dict:
     # Perfetto names tracks before their first slice arrives.
     events.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
                                e.get("ts", 0.0)))
+    dist_block = {
+        "num_ranks": len(docs),
+        "aligned": bool(align),
+        "clock_offsets_us": {str(r): offsets.get(r, 0.0)
+                             for r, _ in docs},
+        "span_counts": {str(r): span_counts[r] for r, _ in docs},
+    }
+    reconcile = reconcile_comms(docs)
+    if reconcile is not None:
+        dist_block["comms_reconcile"] = reconcile
+        bad = [r for r, e in reconcile.items() if e.get("match") is False]
+        if bad:
+            # Embedded for check_trace --dist to FAIL on; the merge
+            # itself still writes the artifact (the mismatch is the
+            # finding, and the trace is the evidence).
+            print(f"merge_traces: WARNING: analytic vs traced all-gather "
+                  f"bytes disagree for rank(s) {bad}: "
+                  f"{ {r: reconcile[r] for r in bad} }", file=sys.stderr)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
-        "dist": {
-            "num_ranks": len(docs),
-            "aligned": bool(align),
-            "clock_offsets_us": {str(r): offsets.get(r, 0.0)
-                                 for r, _ in docs},
-            "span_counts": {str(r): span_counts[r] for r, _ in docs},
-        },
+        "dist": dist_block,
     }
 
 

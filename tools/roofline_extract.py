@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Roofline the on-chip extract solve (VERDICT r4 item 8).
+"""Roofline the on-chip extract solve (VERDICT r4 item 8; ISSUE 3 r6).
 
 Targets EXACTLY the number BENCH records as device_solve_ms_extract
 (97.2 ms in BENCH_r04): bench.stage_extract_inputs' f32-staged arrays,
@@ -13,11 +13,24 @@ tunneled link). Floors:
 2. HBM: the kernel's block sweep re-reads the dataset once per query
    tile: (Qpad/tq) * Npad * A * 4 bytes over the chip's HBM bandwidth.
 
-The gap decomposes into the extraction while-loop (sized by the kernel's
-own iteration diagnostics) + the sort epilogue (timed separately).
+Methodology (r6):
 
-Writes one schema-1 RunRecord (obs.run) whose counters block carries
-the analytic kernel cost model (obs.kernel_cost).
+- The extraction term is MEASURED, not modeled: the kernel's own
+  per-tile ``iters`` output is read back and folded into the counters
+  block via obs.kernel_cost.extract_topk_cost(iters_total=...) — the
+  RunRecord's flops are no longer a deterministic lower bound
+  (ROADMAP item closed).
+- The kernel is timed BOTH with the r6 threshold-gated block skipping
+  (default) and with ``block_skip=False`` (the r5 kernel), interleaved
+  in the same weather window, so the record carries an honest
+  before/after kernel-only ms and %-of-roof pair for the optimization.
+- The variant that ran resolves through the measured autotuner cache
+  (dmlp_tpu.tune) when an entry exists — the record names it either way.
+
+Writes one schema-1 RunRecord (obs.run). On a host with no TPU,
+``--emit-unavailable`` writes an explicit-marker RunRecord (device,
+why, and an interpret-mode parity + measured-iters demonstration at a
+small shape) instead of failing silently — never a missing artifact.
 
 Usage (DEFAULT env, real chip): python tools/roofline_extract.py
     [--out ROOFLINE_r06.json] [--n 204800 --q 10240 --a 64 --k 32]
@@ -35,6 +48,73 @@ import numpy as np
 HBM_GBPS = {"tpu v5 lite": 819.0, "v5e": 819.0}
 
 
+def emit_unavailable(args, dev) -> int:
+    """The honest no-TPU artifact: an explicit roofline_unavailable
+    RunRecord carrying (1) why, (2) what the last real-chip measurement
+    said, (3) an interpret-mode demonstration that the r6 block-skip
+    kernel is output-identical and actually skips warm no-improve blocks
+    (iters 0 vs 1), so the record is evidence, not just an excuse."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from dmlp_tpu.obs.kernel_cost import extract_topk_cost
+    from dmlp_tpu.obs.run import RunRecord
+    from dmlp_tpu.ops.pallas_extract import extract_topk, resolve_variant
+
+    n, nq, a, kc = 1024, 16, 8, 16
+    rng = np.random.default_rng(0)
+    d = jnp.asarray(rng.uniform(0, 100, (n, a)), jnp.float32)
+    q = jnp.asarray(rng.uniform(0, 100, (nq, a)), jnp.float32)
+    # Chunk 1 fresh, chunk 2 the same rows shifted FAR away: no chunk-2
+    # candidate can beat any row's k-th best, so the skip gate must zero
+    # chunk 2's loop count while the r5 kernel pays one full extraction
+    # round per tile discovering the same nothing.
+    d_far = d + 1000.0
+    runs = {}
+    for skip in (True, False):
+        od1, oi1, it1 = extract_topk(q, d, n_real=n, kc=kc,
+                                     interpret=True, block_skip=skip)
+        od2, oi2, it2 = extract_topk(q, d_far, od1, oi1, n_real=n,
+                                     id_base=n, kc=kc, interpret=True,
+                                     block_skip=skip)
+        runs[skip] = (np.asarray(od2), np.asarray(oi2),
+                      int(np.asarray(it1).sum()),
+                      int(np.asarray(it2).sum()))
+    parity = (np.array_equal(runs[True][0], runs[False][0])
+              and np.array_equal(runs[True][1], runs[False][1]))
+    iters_total = runs[True][2] + runs[True][3]
+
+    why = (
+        f"no TPU reachable from this container (backend={dev.platform}); "
+        "the before/after kernel-only timing needs the real chip. Last "
+        "real-chip state (ROOFLINE_r05.json, v5e): 43.6 ms "
+        "dispatch-corrected kernel vs 10.0 ms MXU floor = 22.9% of roof, "
+        "the whole gap the 33.6 ms extraction while-loop over 13773 "
+        "iters. The r6 kernel gates that loop per block (threshold "
+        "prefilter) and resolves variants through the measured tuner "
+        "cache — re-measure with `python -m dmlp_tpu.tune` + "
+        "`python tools/roofline_extract.py` on hardware.")
+    rec = RunRecord(
+        kind="roofline", tool="tools/roofline_extract",
+        config={"device": dev.platform, "shape": [args.n, args.q, args.a],
+                "k": args.k, "requested_reps": args.reps},
+        metrics={
+            "roofline_unavailable": why,
+            "before_after_unavailable": "kernel-only ms requires TPU",
+            "cpu_interpret_check": {
+                "shape": [n, nq, a], "kc": kc,
+                "variant": resolve_variant(kc, n, nq, a),
+                "block_skip_parity": bool(parity),
+                "iters_chunk2_with_skip": runs[True][3],
+                "iters_chunk2_without_skip": runs[False][3],
+            },
+        },
+        counters=extract_topk_cost(nq, n, a, kc, iters_total=iters_total))
+    rec.write(args.out)
+    print(rec.to_json())
+    return 0 if parity else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="ROOFLINE_r06.json")
@@ -43,6 +123,10 @@ def main() -> int:
     ap.add_argument("--a", type=int, default=64)
     ap.add_argument("--k", type=int, default=32)
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--emit-unavailable", action="store_true",
+                    help="on a non-TPU host, write the explicit "
+                         "roofline-unavailable RunRecord (exit 0) "
+                         "instead of failing")
     args = ap.parse_args()
 
     import jax
@@ -50,7 +134,10 @@ def main() -> int:
 
     dev = jax.devices()[0]
     if dev.platform != "tpu":
-        print(f"FATAL: roofline needs the real chip, got {dev.platform}")
+        if args.emit_unavailable:
+            return emit_unavailable(args, dev)
+        print(f"FATAL: roofline needs the real chip, got {dev.platform} "
+              "(--emit-unavailable writes the explicit marker record)")
         return 1
 
     from bench import stage_extract_inputs, time_fenced_solve_ms
@@ -82,6 +169,11 @@ def main() -> int:
         od, _, _ = extract_topk(q_, d_, n_real=n, kc=kc)
         return od
 
+    # --- the r5 kernel (block skipping off): the "before" of the A/B ----
+    def kernel_noskip_fn(q_, d_):
+        od, _, _ = extract_topk(q_, d_, n_real=n, kc=kc, block_skip=False)
+        return od
+
     # --- MXU floor: bare fused distance matmul, same precision/fence ----
     @jax.jit
     def dist_only(q_, d_):
@@ -102,7 +194,7 @@ def main() -> int:
     # they share weather, making the subtraction-based decomposition
     # meaningful (verify-skill methodology).
     fns = {"dispatch": trivial, "solve": solve_fn, "kernel": kernel_fn,
-           "mxu": dist_only}
+           "kernel_noskip": kernel_noskip_fn, "mxu": dist_only}
     rounds = {k: [] for k in fns}
     for r in range(5):
         for name in (list(fns) if r % 2 == 0 else list(fns)[::-1]):
@@ -111,20 +203,23 @@ def main() -> int:
     med = {k: float(np.median(v)) for k, v in rounds.items()}
     dispatch_ms = med["dispatch"]
     solve_ms, kernel_ms, mxu_ms = med["solve"], med["kernel"], med["mxu"]
+    noskip_ms = med["kernel_noskip"]
 
     # --- HBM floor (actual resolved tiles; kernel streams f32) ----------
-    v = _resolve_variant(kc, npad)
+    v = _resolve_variant(kc, npad, qpad, a)
     tq = _tile(qpad, v["tile_q"], 8)
-    tn = _tile(npad, BLOCK_ROWS, 128 * v["ne"])
+    tn = _tile(npad, v.get("tile_n", BLOCK_ROWS), 128 * v["ne"])
     sweep_bytes = (qpad // tq) * npad * a * 4 + (npad // tn) * qpad * a * 4
     bw = next((g for k_, g in HBM_GBPS.items()
                if k_ in dev.device_kind.lower()), 819.0)
     hbm_floor_ms = sweep_bytes / (bw * 1e9) * 1e3
 
-    # --- extraction-iteration diagnostics -------------------------------
+    # --- extraction-iteration diagnostics (measured, both kernels) ------
     _, _, iters = extract_topk(qd, dd, n_real=n, kc=kc)
-    iters = np.asarray(iters)
-    total_iters = int(iters.sum())
+    total_iters = int(np.asarray(iters).sum())
+    _, _, iters_ns = extract_topk(qd, dd, n_real=n, kc=kc,
+                                  block_skip=False)
+    total_iters_noskip = int(np.asarray(iters_ns).sum())
 
     flops = 2.0 * npad * qpad * a
     # Single-dispatch chains (kernel, mxu, dispatch) are directly
@@ -133,6 +228,7 @@ def main() -> int:
     # dispatch) sits BELOW tunnel noise — consecutive enqueues pipeline —
     # so the epilogue is reported raw, not as a corrected term.
     kernel_c = kernel_ms - dispatch_ms
+    noskip_c = noskip_ms - dispatch_ms
     mxu_c = max(mxu_ms - dispatch_ms, 1e-6)
     floor = max(mxu_c, hbm_floor_ms)
     rec = {
@@ -142,11 +238,19 @@ def main() -> int:
         "dispatch_overhead_ms": round(dispatch_ms, 2),
         "raw_ms": {"solve_with_epilogue": round(solve_ms, 2),
                    "kernel_only": round(kernel_ms, 2),
+                   "kernel_only_noskip": round(noskip_ms, 2),
                    "mxu_matmul": round(mxu_ms, 2)},
+        # before = the r5 kernel (block_skip off), after = r6 (skip on);
+        # interleaved same-weather medians, dispatch-corrected.
         "corrected": {
+            "kernel_ms_before": round(noskip_c, 2),
             "kernel_ms": round(kernel_c, 2),
+            "block_skip_speedup": round(noskip_c / max(kernel_c, 1e-6), 3),
             "mxu_floor_ms": round(mxu_c, 2),
+            "extraction_term_ms_before": round(noskip_c - mxu_c, 2),
             "extraction_term_ms": round(kernel_c - mxu_c, 2),
+            "pct_of_roof_before": round(
+                100.0 * floor / max(noskip_c, 1e-6), 1),
             "pct_of_roof": round(100.0 * floor / max(kernel_c, 1e-6), 1),
         },
         "mxu_achieved_tflops_f32_highest": round(
@@ -155,21 +259,28 @@ def main() -> int:
         "hbm_bw_gbps_assumed": bw,
         "sweep_gb": round(sweep_bytes / 1e9, 2),
         "extract_iters_total": total_iters,
+        "extract_iters_total_noskip": total_iters_noskip,
     }
     rec["verdict"] = (
         f"binding floor = {'MXU' if mxu_c > hbm_floor_ms else 'HBM'} "
         f"({floor:.1f} ms, dispatch-corrected) at HIGHEST-precision f32 "
         f"matmul ({rec['mxu_achieved_tflops_f32_highest']} TFLOP/s); "
-        f"kernel at {rec['corrected']['pct_of_roof']}% of roof; gap = "
-        f"extraction while-loop {rec['corrected']['extraction_term_ms']} "
-        f"ms over {total_iters} iters; sort epilogue is below tunnel "
-        f"noise (raw solve {rec['raw_ms']['solve_with_epilogue']} vs "
-        f"kernel {rec['raw_ms']['kernel_only']} ms); each dispatch adds "
+        f"block-skip kernel {rec['corrected']['kernel_ms']} ms "
+        f"({rec['corrected']['pct_of_roof']}% of roof) vs "
+        f"{rec['corrected']['kernel_ms_before']} ms "
+        f"({rec['corrected']['pct_of_roof_before']}%) without = "
+        f"{rec['corrected']['block_skip_speedup']}x on the kernel; "
+        f"measured extraction term "
+        f"{rec['corrected']['extraction_term_ms']} ms over {total_iters} "
+        f"iters ({total_iters_noskip} without skip); sort epilogue is "
+        f"below tunnel noise (raw solve "
+        f"{rec['raw_ms']['solve_with_epilogue']} vs kernel "
+        f"{rec['raw_ms']['kernel_only']} ms); each dispatch adds "
         f"~{rec['dispatch_overhead_ms']} ms tunnel wall time")
 
     # One schema-1 RunRecord (obs.run); the counters block carries the
-    # analytic kernel model (obs.kernel_cost) — the same numbers the
-    # engine CLI now reports for pallas dispatches on TPU.
+    # kernel cost model WITH the measured extraction term folded in
+    # (obs.kernel_cost, iters_total) — measured, not a lower bound.
     from dmlp_tpu.obs.kernel_cost import extract_topk_cost
     from dmlp_tpu.obs.run import RunRecord
     record = RunRecord(
@@ -177,7 +288,8 @@ def main() -> int:
         config={"device": dev.device_kind, "shape": [n, q, a],
                 "k": args.k, "kc": kc, "reps": args.reps},
         metrics=rec,
-        counters=extract_topk_cost(qpad, npad, a, kc))
+        counters=extract_topk_cost(qpad, npad, a, kc,
+                                   iters_total=total_iters))
     record.write(args.out)
     print(record.to_json())
     return 0
