@@ -5,7 +5,8 @@
 CXX ?= g++
 CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
-.PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke clean
+.PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
+	perf-gate clean
 
 all: native
 
@@ -14,7 +15,7 @@ native: native/_fastparse.so
 native/_fastparse.so: native/fastparse.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
-test: obs-smoke obs-dist-smoke tune-smoke
+test: obs-smoke obs-dist-smoke tune-smoke perf-gate
 	python -m pytest tests/ -q
 
 # Tier-1 no-regression guard (ROADMAP "Tier-1 verify"): on this
@@ -69,6 +70,21 @@ tune-smoke:
 	  python -m dmlp_tpu.tune --smoke --record outputs/TUNE_SMOKE.json
 	JAX_PLATFORMS=cpu python -m dmlp_tpu.tune \
 	  --validate outputs/tune_smoke_cache.json
+
+# Perf ledger + regression sentinel: build the ledger over every root
+# artifact (schema RunRecords + grandfathered legacy shapes; >= 90%
+# parsed or the smoke fails, the rest explicit unparseable entries),
+# write the trajectory report, then gate tracked series — a round that
+# regresses a gated series beyond its noise band on comparable devices
+# fails the build (honest insufficient_trials / device_mismatch
+# markers never do).
+perf-gate:
+	mkdir -p outputs
+	JAX_PLATFORMS=cpu python -m dmlp_tpu.report \
+	  --out outputs/LEDGER.json --md outputs/PERF_REPORT.md \
+	  --min-coverage 0.9
+	JAX_PLATFORMS=cpu python tools/perf_gate.py \
+	  --ledger outputs/LEDGER.json
 
 clean:
 	rm -f native/_fastparse.so

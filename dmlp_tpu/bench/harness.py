@@ -281,7 +281,8 @@ def run_config(config_id: int, base_dir: str = ".",
                reps: int = 1, trace_dir: Optional[str] = None,
                counters: bool = False,
                record_path: Optional[str] = None,
-               profile_dir: Optional[str] = None) -> dict:
+               profile_dir: Optional[str] = None,
+               obs_overhead: bool = False) -> dict:
     """Full benchmark flow for one config; returns a result summary dict.
 
     ``reps`` > 1 runs the engine subprocess that many times and reports
@@ -309,6 +310,15 @@ def run_config(config_id: int, base_dir: str = ".",
     virtual-CPU platform (``cfg.virtual_devices``) or an environment
     pinned to CPU records the explicit ``profile_unavailable`` marker
     instead of a capture — never a silently absent artifact.
+
+    ``obs_overhead`` SELF-MEASURES the observability layer's cost: the
+    engine runs in interleaved pairs — tracing+counters OFF then ON
+    (order alternating per pair, the BENCH_MODES_r04 weather
+    methodology) — and the result records ``obs_overhead_pct``
+    (median-on vs median-off engine time) plus both raw sample lists,
+    so the obs layer's own overhead becomes a tracked ledger series
+    instead of a "<2%, trust us" claim. Single-process configs only;
+    failures record the explicit ``obs_overhead_unavailable`` marker.
     """
     import sys
 
@@ -316,6 +326,17 @@ def run_config(config_id: int, base_dir: str = ".",
     cfg = BENCH_CONFIGS[config_id]
     inputs_dir = os.path.join(base_dir, "inputs")
     outputs_dir = os.path.join(base_dir, "outputs")
+
+    # One cpu-pinned verdict for both the profile marker and the
+    # RunRecord device field: virtual-device configs and JAX_PLATFORMS=
+    # cpu environments run the engine on CPU; anything else is the real
+    # backend, which the parent must NOT probe (jax.devices() here could
+    # dial the TPU while engine subprocesses own it) — so device stays
+    # unset rather than guessed, and the ledger's device_mismatch guard
+    # treats it as unspecified.
+    cpu_pinned = bool(cfg.virtual_devices) or (
+        (env if env is not None else os.environ)
+        .get("JAX_PLATFORMS", "") == "cpu")
 
     obs_flags: list = []
     if counters:
@@ -329,9 +350,6 @@ def run_config(config_id: int, base_dir: str = ".",
                                    f"metrics_config{config_id}.jsonl")]
     profile: Optional[tuple] = None   # ("path", p) | ("unavailable", why)
     if profile_dir:
-        cpu_pinned = bool(cfg.virtual_devices) or (
-            (env if env is not None else os.environ)
-            .get("JAX_PLATFORMS", "") == "cpu")
         if cpu_pinned:
             profile = ("unavailable", "cpu platform (virtual devices or "
                        "JAX_PLATFORMS=cpu) — on-device XLA capture needs "
@@ -403,7 +421,7 @@ def run_config(config_id: int, base_dir: str = ".",
                            f"engine run failed ({kind.lower()})")
             if record_path:
                 _append_run_record(record_path, cfg, res, trace_dir,
-                                   profile=profile)
+                                   profile=profile, cpu_pinned=cpu_pinned)
             return res
         with open(engine_out) as f:
             got_r = f.read()
@@ -457,15 +475,77 @@ def run_config(config_id: int, base_dir: str = ".",
         # backend rejected the capture): an explicit marker, not a
         # RunRecord pointing at an empty directory.
         profile = ("unavailable", "engine wrote no capture")
+    if obs_overhead:
+        res.update(_measure_obs_overhead(
+            cfg, input_path, outputs_dir, out, mode=mode, fast=fast,
+            timeout_s=timeout_s, env=env, pairs=n_reps))
     if record_path:
         _append_run_record(record_path, cfg, res, trace_dir,
-                           profile=profile)
+                           profile=profile, cpu_pinned=cpu_pinned)
     return res
+
+
+def _measure_obs_overhead(cfg: BenchConfig, input_path: str,
+                          outputs_dir: str, out: TextIO,
+                          mode: Optional[str], fast: bool,
+                          timeout_s: float, env: Optional[dict],
+                          pairs: int) -> dict:
+    """Interleaved obs-on/obs-off engine timings -> the
+    ``obs_overhead_pct`` fields (see run_config docstring). "On" means
+    the full opt-in capture stack: span tracing + metrics JSONL +
+    cost-analysis counters, exactly what ``--trace/--metrics/
+    --counters`` enable. Never raises: any failed run yields the
+    explicit ``obs_overhead_unavailable`` marker instead."""
+    import statistics
+
+    if cfg.procs > 1:
+        return {"obs_overhead_unavailable": "multi-process config "
+                "(observability capture is single-process only)"}
+    on_flags = ["--trace",
+                os.path.join(outputs_dir,
+                             f"obs_overhead_trace_c{cfg.config_id}.json"),
+                "--metrics",
+                os.path.join(outputs_dir,
+                             f"obs_overhead_metrics_c{cfg.config_id}.jsonl"),
+                "--counters"]
+    times: dict = {"off": [], "on": []}
+    try:
+        for rep in range(max(pairs, 1)):
+            order = ("off", "on") if rep % 2 == 0 else ("on", "off")
+            for arm in order:
+                _, err_path = run_engine(
+                    cfg, input_path, outputs_dir, mode=mode, fast=fast,
+                    timeout_s=timeout_s, env=env,
+                    obs_flags=on_flags if arm == "on" else None)
+                with open(err_path) as f:
+                    ms = _extract_ms(f.read())
+                if ms is None:
+                    return {"obs_overhead_unavailable":
+                            f"no timing line in the {arm}-arm run"}
+                times[arm].append(ms)
+    except (EngineTimeout, RuntimeError) as e:
+        return {"obs_overhead_unavailable":
+                f"engine run failed during the A/B: {e}"}
+    med_off = statistics.median(times["off"])
+    med_on = statistics.median(times["on"])
+    if med_off <= 0:
+        return {"obs_overhead_unavailable":
+                "off-arm median rounded to 0 ms (a percentage would "
+                "be meaningless)", "engine_ms_obs_off": times["off"],
+                "engine_ms_obs_on": times["on"]}
+    pct = (med_on - med_off) / med_off * 100.0
+    out.write(f"Config {cfg.config_id}: obs overhead "
+              f"{pct:+.1f}% (median {med_off} -> {med_on} ms over "
+              f"{len(times['off'])} interleaved pair(s))\n")
+    return {"obs_overhead_pct": round(pct, 2),
+            "engine_ms_obs_off": times["off"],
+            "engine_ms_obs_on": times["on"]}
 
 
 def _append_run_record(record_path: str, cfg: BenchConfig, res: dict,
                        trace_dir: Optional[str],
-                       profile: Optional[tuple] = None) -> None:
+                       profile: Optional[tuple] = None,
+                       cpu_pinned: bool = False) -> None:
     """One versioned RunRecord per config run (obs.run) — the uniform
     artifact new bench emitters share instead of private BENCH_* shapes.
     ``profile`` is ("path", dir) to link an on-device capture from the
@@ -495,9 +575,17 @@ def _append_run_record(record_path: str, cfg: BenchConfig, res: dict,
             artifacts["profile"] = profile[1]
         else:
             metrics["profile_unavailable"] = profile[1]
+    from dmlp_tpu.obs.run import round_from_name
+    # Schema-2 envelope fields the ledger keys on. Device is only
+    # recorded when the harness KNOWS it (the cpu_pinned verdict from
+    # run_config: virtual devices OR a JAX_PLATFORMS=cpu environment);
+    # the parent must not touch jax.devices() to find out — that can
+    # dial the TPU while engine subprocesses own it.
+    device = "cpu" if cpu_pinned else None
     RunRecord(kind="bench", tool="dmlp_tpu.bench",
               config=dataclasses.asdict(cfg), metrics=metrics,
-              artifacts=artifacts).append_jsonl(record_path)
+              artifacts=artifacts, device=device,
+              round=round_from_name(record_path)).append_jsonl(record_path)
 
 
 def reference_binary_fields(cap_path: str, config_id: int,
@@ -562,6 +650,11 @@ def main(argv=None) -> int:
                         "DIR/profile_configN (real-TPU runs; CPU configs "
                         "record the profile_unavailable marker), linked "
                         "from the config's RunRecord artifacts")
+    p.add_argument("--obs-overhead", action="store_true",
+                   help="self-measure the observability layer: run "
+                        "interleaved engine pairs with tracing+counters "
+                        "off vs on and record obs_overhead_pct in the "
+                        "config's RunRecord (single-process configs)")
     args = p.parse_args(argv)
 
     ids = list(BENCH_CONFIGS) if args.config == "all" else [int(args.config)]
@@ -572,7 +665,8 @@ def main(argv=None) -> int:
                          timeout_s=args.timeout, reps=args.reps,
                          trace_dir=args.trace_dir, counters=args.counters,
                          record_path=args.metrics,
-                         profile_dir=args.profile_dir)
+                         profile_dir=args.profile_dir,
+                         obs_overhead=args.obs_overhead)
         ok = ok and res["checksums_match"]
     return 0 if ok else 1
 

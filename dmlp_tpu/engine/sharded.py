@@ -31,8 +31,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dmlp_tpu.config import EngineConfig
 from dmlp_tpu.engine.finalize import (boundary_overflow, finalize_host,
                                       repair_boundary_overflow, staging_eps)
-from dmlp_tpu.engine.single import (ChunkThrottle, fit_blocks, pad_dataset,
-                                    resolve_kcap, round_up)
+from dmlp_tpu.engine.single import (ChunkThrottle, MeasuredIters,
+                                    fit_blocks, flush_measured_iters,
+                                    pad_dataset, resolve_kcap, round_up)
 from dmlp_tpu.io.grammar import KNNInput
 from dmlp_tpu.io.report import QueryResult
 from dmlp_tpu.obs import counters as obs_counters
@@ -94,6 +95,11 @@ class ShardedEngine:
         self.last_phase_ms: Dict[str, float] = {}
         self.last_hetk = None  # (bulk, outlier) counts when routing split
         self.last_comms: list = []  # obs.comms traffic of the last solve
+        # (site, device iters-sum scalar, shape) queue for the measured
+        # extraction term — same protocol as engine.single (the mesh
+        # programs return per-shard kernel iters through their fold
+        # outputs; engine.single.flush_measured_iters drains post-fence)
+        self._pending_iters: list = []
 
     def _np_dtype(self):
         """Wire dtype from the engine's (possibly no_auto_coarsen-swapped)
@@ -142,8 +148,14 @@ class ShardedEngine:
         """Per-cell solver closure: the flagship extraction kernel when the
         plan selected it (its SMEM runtime scalars make the per-shard
         id_base/n_real traced values, so one compiled kernel serves every
-        shard), the streaming fold otherwise. Returns possibly-UNSORTED
-        lists — both merges re-select with the composite sort."""
+        shard), the streaming fold otherwise. Returns (TopK, iters)
+        where ``iters`` is this cell's summed kernel loop-iteration
+        count as a (1, 1) i32 — the per-shard extract iters previously
+        trapped inside the shard_map program, now threaded through the
+        fold outputs so the mesh engines can report the MEASURED
+        extraction term (the streaming selects have no such loop and
+        return 0). Lists are possibly UNSORTED — both merges re-select
+        with the composite sort."""
         if select == "extract":
             from dmlp_tpu.ops.pallas_distance import native_pallas_backend
             from dmlp_tpu.ops.pallas_extract import extract_topk
@@ -156,20 +168,22 @@ class ShardedEngine:
                 # shard: base from the first id, count from the mask.
                 nreal = jnp.sum((data_i >= 0).astype(jnp.int32))
                 base = jnp.maximum(data_i[0], 0)
-                od, oi, _ = extract_topk(q_attrs, data_a, n_real=nreal,
-                                         id_base=base, kc=k,
-                                         interpret=interpret)
+                od, oi, its = extract_topk(q_attrs, data_a, n_real=nreal,
+                                           id_base=base, kc=k,
+                                           interpret=interpret)
                 lab = jnp.where(
                     oi >= 0, data_l[jnp.clip(oi - base, 0, sr - 1)], -1)
-                return TopK(od, lab, oi)
+                return TopK(od, lab, oi), \
+                    jnp.sum(its, dtype=jnp.int32)[None, None]
             return solve_shard
 
         use_pallas = self.config.use_pallas
 
         def solve_shard(data_a, data_l, data_i, q_attrs):
-            return streaming_topk(q_attrs, data_a, data_l, data_i,
-                                  k=k, data_block=data_block,
-                                  select=select, use_pallas=use_pallas)
+            top = streaming_topk(q_attrs, data_a, data_l, data_i,
+                                 k=k, data_block=data_block,
+                                 select=select, use_pallas=use_pallas)
+            return top, jnp.zeros((1, 1), jnp.int32)
         return solve_shard
 
     def _fn(self, k: int, data_block: int, select: str):
@@ -179,16 +193,17 @@ class ShardedEngine:
             solve_shard = self._solve_shard_fn(k, data_block, select)
 
             def local(data_a, data_l, data_i, q_attrs):
-                top = solve_shard(data_a, data_l, data_i, q_attrs)
+                top, its = solve_shard(data_a, data_l, data_i, q_attrs)
                 if merge == "allgather":
-                    return allgather_merge_topk(top, k, DATA_AXIS)
-                return ring_allreduce_topk(top, k, DATA_AXIS)
+                    return allgather_merge_topk(top, k, DATA_AXIS), its
+                return ring_allreduce_topk(top, k, DATA_AXIS), its
 
             sharded = shard_map(
                 local, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                           P(QUERY_AXIS, None)),
-                out_specs=P(QUERY_AXIS, None),
+                out_specs=(P(QUERY_AXIS, None),
+                           P(DATA_AXIS, QUERY_AXIS)),
                 check_vma=False)
             self._fns[key] = jax.jit(sharded)
         return self._fns[key]
@@ -243,10 +258,14 @@ class ShardedEngine:
 
             def local(cd, ci, chunk_a, q_attrs, sc):
                 id_base, n_real = _chunk_span(sc, chunk_a.shape[0])
-                od, oi, _ = extract_topk(q_attrs, chunk_a, cd[0], ci[0],
-                                         n_real=n_real, id_base=id_base,
-                                         kc=k, interpret=interpret)
-                return od[None], oi[None]
+                od, oi, its = extract_topk(q_attrs, chunk_a, cd[0], ci[0],
+                                           n_real=n_real, id_base=id_base,
+                                           kc=k, interpret=interpret)
+                # Per-cell summed kernel loop iterations ride out as a
+                # third fold output ((R, C) after shard_map) so the
+                # measured extraction term covers the mesh path too.
+                return od[None], oi[None], \
+                    jnp.sum(its, dtype=jnp.int32)[None, None]
 
             self._fns[key] = jax.jit(shard_map(
                 local, mesh=self.mesh,
@@ -254,7 +273,8 @@ class ShardedEngine:
                           P(DATA_AXIS, QUERY_AXIS, None),
                           P(DATA_AXIS, None), P(QUERY_AXIS, None), P()),
                 out_specs=(P(DATA_AXIS, QUERY_AXIS, None),
-                           P(DATA_AXIS, QUERY_AXIS, None)),
+                           P(DATA_AXIS, QUERY_AXIS, None),
+                           P(DATA_AXIS, QUERY_AXIS)),
                 check_vma=False))
         return self._fns[key]
 
@@ -462,6 +482,8 @@ class ShardedEngine:
 
         src = np.ascontiguousarray(inp.data_attrs, np.float32)
         throttle = ChunkThrottle()
+        mi = MeasuredIters(self, "sharded.chunk_fold",
+                           (qloc, chunk_rows, na, k))
         from dmlp_tpu.ops.pallas_extract import resolve_variant
         with obs_span("sharded.enqueue_chunked", chunks=nchunks,
                       mesh=[r, c], kc=k,
@@ -488,11 +510,13 @@ class ShardedEngine:
                     obs_counters.record_dispatch(
                         step, (cd, ci, a_dev, q_dev, sc), count=nchunks,
                         site="sharded.chunk_fold")
-                cd, ci = step(cd, ci, a_dev, q_dev, sc)
+                cd, ci, its = step(cd, ci, a_dev, q_dev, sc)
+                mi.add(its)
                 if ostep is not None:
                     od, ol, oi = ostep(od, ol, oi, a_dev, qo_dev, lab_dev,
                                        sc)
                 throttle.tick(od if ostep is not None else cd)
+        mi.done()
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         # Collective-traffic accounting from the shapes actually merged
@@ -524,6 +548,7 @@ class ShardedEngine:
         self.last_phase_ms = {}  # no stale phases if a path is skipped
         self.last_hetk = None    # routed=False below: no split ever fires
         self.last_comms = []     # no stale traffic either
+        self._pending_iters = []
         out = self._solve_chunked_extract(inp, routed=False)
         if out is not None:
             top, _ = out
@@ -534,9 +559,11 @@ class ShardedEngine:
             self._last_select = select  # run() gates the tie-overflow repair
             top = self._solve_merged(k, data_block, select, d_attrs,
                                      d_labels, d_ids, q_attrs)
-        return (np.asarray(top.dists, np.float64)[:nq],
-                np.asarray(top.labels)[:nq],
-                np.asarray(top.ids)[:nq])
+        out_np = (np.asarray(top.dists, np.float64)[:nq],
+                  np.asarray(top.labels)[:nq],
+                  np.asarray(top.ids)[:nq])
+        flush_measured_iters(self)  # post-fetch: a scalar readback
+        return out_np
 
     def _solve_merged(self, k: int, data_block: int, select: str,
                       d_attrs, d_labels, d_ids, q_attrs):
@@ -551,9 +578,23 @@ class ShardedEngine:
                                        q_attrs.shape[0] // c, k)
         with obs_span("sharded.solve_merge", select=select, mesh=[r, c],
                       kcap=k) as sp:
-            top = fn(*args)
+            top, its = fn(*args)
             sp.fence(top.dists)
+        self._queue_iters("sharded.solve_merge", select, its,
+                          q_attrs.shape[0] // c, d_attrs.shape[0] // r,
+                          d_attrs.shape[1], k)
         return top
+
+    def _queue_iters(self, site: str, select: str, its,
+                     qloc: int, shard_rows: int, na: int, k: int) -> None:
+        """Queue a mesh program's per-shard kernel iters (summed over
+        cells) for the post-fence measured-extraction-term flush; no-op
+        for non-extract selects or without an installed probe."""
+        if select != "extract":
+            return
+        mi = MeasuredIters(self, site, (qloc, shard_rows, na, k))
+        mi.add(its)
+        mi.done()
 
     def _solve_segments(self, inp: KNNInput):
         """Solve as (TopK, qpad, query_idx | None, select) segments — the
@@ -563,6 +604,7 @@ class ShardedEngine:
         self.last_hetk = None
         self.last_phase_ms = {}
         self.last_comms = []
+        self._pending_iters = []
         out = self._solve_chunked_extract(inp)
         if isinstance(out, list):
             return out
@@ -589,8 +631,13 @@ class ShardedEngine:
         """
         select, data_block, k = self._plan_shard(d_attrs, q_attrs, kmax,
                                                  merged_width=True)
-        return self._fn(k, data_block, select)(d_attrs, d_labels, d_ids,
-                                               q_attrs)
+        r, c = self.mesh.devices.shape
+        top, its = self._fn(k, data_block, select)(d_attrs, d_labels,
+                                                   d_ids, q_attrs)
+        self._queue_iters("sharded.solve_global", select, its,
+                          q_attrs.shape[0] // c, d_attrs.shape[0] // r,
+                          d_attrs.shape[1], k)
+        return top
 
     def _plan_shard(self, d_attrs, q_attrs, kmax: int, merged_width: bool):
         """Per-shard blocking plan for pre-placed global arrays.
@@ -642,7 +689,7 @@ class ShardedEngine:
             solve_shard = self._solve_shard_fn(k, data_block, select)
 
             def local(data_a, data_l, data_i, q_attrs):
-                top = solve_shard(data_a, data_l, data_i, q_attrs)
+                top, its = solve_shard(data_a, data_l, data_i, q_attrs)
                 if select == "extract":
                     # The multi-host rescore reads kth/last POSITIONS of
                     # each per-shard list (tie-hazard check), so the
@@ -650,13 +697,14 @@ class ShardedEngine:
                     # here; the merged path's collectives re-sort anyway.
                     from dmlp_tpu.ops.topk import select_topk
                     top = select_topk(top.dists, top.labels, top.ids, k)
-                return jax.tree.map(lambda t: t[None], top)  # (1, qloc, K)
+                return jax.tree.map(lambda t: t[None], top), its
 
             sharded = shard_map(
                 local, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                           P(QUERY_AXIS, None)),
-                out_specs=P(DATA_AXIS, QUERY_AXIS, None),
+                out_specs=(P(DATA_AXIS, QUERY_AXIS, None),
+                           P(DATA_AXIS, QUERY_AXIS)),
                 check_vma=False)
             self._fns[key] = jax.jit(sharded)
         return self._fns[key]
@@ -674,7 +722,11 @@ class ShardedEngine:
         r, c = self.mesh.devices.shape
         with obs_span("sharded.solve_local_shards", select=select,
                       mesh=[r, c], kcap=k):
-            return fn(d_attrs, d_labels, d_ids, q_attrs)
+            top, its = fn(d_attrs, d_labels, d_ids, q_attrs)
+        self._queue_iters("sharded.solve_local_shards", select, its,
+                          q_attrs.shape[0] // c, d_attrs.shape[0] // r,
+                          d_attrs.shape[1], k)
+        return top
 
     def run(self, inp: KNNInput) -> List[QueryResult]:
         from dmlp_tpu.engine.single import staging_for_k
@@ -744,6 +796,7 @@ class ShardedEngine:
             final_ms += (_time.perf_counter() - t0) * 1e3
         self.last_phase_ms["fetch"] = fetch_ms
         self.last_phase_ms["finalize"] = final_ms
+        flush_measured_iters(self)  # post-fence: a scalar readback
         return merged
 
     def _fn_full(self, k: int, data_block: int, select: str,
@@ -763,7 +816,7 @@ class ShardedEngine:
                 # both merges re-select with the composite sort (the
                 # 1-member-axis ring case included), so report_order's
                 # selection-order precondition holds either way.
-                top = solve_shard(data_a, data_l, data_i, q_attrs)
+                top, its = solve_shard(data_a, data_l, data_i, q_attrs)
                 if merge == "allgather":
                     top = allgather_merge_topk(top, k, DATA_AXIS)
                 else:
@@ -771,14 +824,15 @@ class ShardedEngine:
                 rd, rids, in_k = report_order(top, ks)
                 valid = in_k & (top.ids >= 0)
                 predicted = majority_vote(top.labels, valid, num_labels)
-                return predicted, rids, rd
+                return predicted, rids, rd, its
 
             sharded = shard_map(
                 local, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                           P(QUERY_AXIS, None), P(QUERY_AXIS)),
                 out_specs=(P(QUERY_AXIS), P(QUERY_AXIS, None),
-                           P(QUERY_AXIS, None)),
+                           P(QUERY_AXIS, None),
+                           P(DATA_AXIS, QUERY_AXIS)),
                 check_vma=False)
             self._fns[key] = jax.jit(sharded)
         return self._fns[key]
@@ -804,6 +858,7 @@ class ShardedEngine:
         self.last_phase_ms = {}  # no stale phases if a path is skipped
         self.last_hetk = None
         self.last_comms = []
+        self._pending_iters = []
         out = self._solve_chunked_extract(inp)
         if out is not None:
             from dmlp_tpu.engine.single import _device_epilogue
@@ -829,6 +884,7 @@ class ShardedEngine:
                         int(gids[qi]), int(sub.ks[qi]), int(preds[qi]),
                         rids[qi, : int(sub.ks[qi])].astype(np.int64),
                         rd[qi, : int(sub.ks[qi])])
+            flush_measured_iters(self)
             return merged
 
         select, data_block, qgran, k = self._plan_local(inp)
@@ -850,15 +906,20 @@ class ShardedEngine:
                                        qpad // c, k)
         with obs_span("sharded.device_full", select=select,
                       mesh=[r, c]) as sp:
-            p, i, d = fn_full(*full_args)
+            p, i, d, its = fn_full(*full_args)
             sp.fence(d)
+        self._queue_iters("sharded.device_full", select, its,
+                          qpad // c, d_attrs.shape[0] // r,
+                          d_attrs.shape[1], k)
         preds = np.asarray(p)[:nq]
         rids = np.asarray(i)[:nq]
         rd = np.asarray(d, np.float64)[:nq]
-        return [QueryResult(qi, int(inp.ks[qi]), int(preds[qi]),
-                            rids[qi, : int(inp.ks[qi])].astype(np.int64),
-                            rd[qi, : int(inp.ks[qi])])
-                for qi in range(nq)]
+        results = [QueryResult(qi, int(inp.ks[qi]), int(preds[qi]),
+                               rids[qi, : int(inp.ks[qi])].astype(np.int64),
+                               rd[qi, : int(inp.ks[qi])])
+                   for qi in range(nq)]
+        flush_measured_iters(self)
+        return results
 
 
 class RingEngine(ShardedEngine):

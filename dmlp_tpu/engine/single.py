@@ -220,6 +220,26 @@ class MeasuredIters:
                 (self._site, self._sum, self._shape))
 
 
+def flush_measured_iters(engine) -> None:
+    """Read back an engine's queued extract-loop iters sums (the solve
+    is already fenced by the result fetch, so this is a scalar readback,
+    not a sync) and hand them to the installed cost probe — the
+    MEASURED extraction term of obs.kernel_cost. No-op when nothing was
+    queued (no probe, or a non-extract path ran). Shared by the
+    single-chip engine and the mesh engines (both queue through
+    MeasuredIters onto ``engine._pending_iters``)."""
+    pend = getattr(engine, "_pending_iters", [])
+    engine._pending_iters = []
+    if not pend:
+        return
+    for site, s, shape in pend:
+        try:
+            obs_counters.record_measured_iters(
+                site, int(jax.device_get(s)), shape)
+        except Exception:
+            pass  # observability must never fail the solve
+
+
 @contextlib.contextmanager
 def no_auto_coarsen(engine):
     """Device-full output IS the device ordering (no f64 rescore or host
@@ -794,20 +814,7 @@ class SingleChipEngine:
         return [(top, qpad, None, "extract")]
 
     def _flush_measured_iters(self) -> None:
-        """Read back the queued extract-loop iters sums (the solve is
-        already fenced by the result fetch, so this is a scalar readback,
-        not a sync) and hand them to the installed cost probe — the
-        MEASURED extraction term of obs.kernel_cost. No-op when nothing
-        was queued (no probe, or a non-extract path ran)."""
-        pend, self._pending_iters = self._pending_iters, []
-        if not pend:
-            return
-        for site, s, shape in pend:
-            try:
-                obs_counters.record_measured_iters(
-                    site, int(jax.device_get(s)), shape)
-            except Exception:
-                pass  # observability must never fail the solve
+        flush_measured_iters(self)
 
     def _solve(self, inp: KNNInput) -> Tuple[TopK, int]:
         self.last_phase_ms = {}  # no stale phases if a path is skipped

@@ -28,6 +28,13 @@ timing/metrics schemas:
 - :mod:`dmlp_tpu.obs.run` — the versioned :class:`RunRecord` artifact
   writer all emitters share (replacing the divergent ``BENCH_*.json``
   shapes going forward; the legacy ``tools/*`` emitters are migrated).
+- :mod:`dmlp_tpu.obs.ledger` — the perf ledger: ingests every run
+  artifact (schema RunRecords AND the grandfathered legacy shapes)
+  into per-series round-keyed trajectories with noise-aware A/B deltas
+  (MAD bands over per-trial samples; explicit ``insufficient_trials``
+  / ``device_mismatch`` markers). Rendered by ``python -m
+  dmlp_tpu.report``; gated by ``tools/perf_gate.py`` (``make
+  perf-gate``).
 
 Every module here is import-light: none of them import jax at module
 level, so the CLI's fast startup path is unaffected when observability is

@@ -108,6 +108,12 @@ class DistTracer(obs_trace.Tracer):
             "num_ranks": self.num_ranks,
             "os_pid": self._os_pid,
             "clock_sync_ts_us": self._clock_sync_ts_us,
+            # The rank file's OWN domain is still per-process monotonic
+            # (the sync instant is alignment *input*, not applied);
+            # merge_traces stamps the merged doc "synced" after it
+            # applies the offsets, and refuses to skew-compare rank
+            # files from mixed domains.
+            "clock_source": self.clock_source,
         }
         if self.mesh_coords:
             doc["dist"]["mesh"] = self.mesh_coords

@@ -22,9 +22,10 @@ Model scope, per kernel:
   ``CostProbe.record_measured_iters``) to add the MEASURED extraction
   term (:func:`extract_loop_cost`); the returned dict then carries
   ``extraction_term: "measured"`` instead of ``"modeled_lower_bound"``.
-  The single-chip engine extract paths do this whenever a probe is
-  installed; the sharded engines' per-shard iters stay inside the
-  shard_map program and keep the lower bound.
+  Both the single-chip engine extract paths AND the mesh engines do
+  this whenever a probe is installed: the sharded programs return each
+  cell's summed iters through their shard_map fold outputs
+  (engine.sharded), so the sharded extraction term is measured too.
 - **bytes_accessed** count HBM traffic implied by the BlockSpec sweep:
   each query tile re-reads the data panel and each data block re-reads
   the query panel (Pallas streams blocks from HBM each grid step; only

@@ -12,6 +12,14 @@ write RunRecords (the bench harness and the engine CLI already do).
 Records serialize as strict JSON. ``write`` emits one record per file;
 ``append_jsonl`` appends one record per line for multi-run logs — both
 atomic enough for the single-writer tooling here.
+
+Schema 2 promotes the two fields the perf ledger (obs.ledger) keys
+series on from free-form payload convention to the envelope: ``round``
+(the measurement round, the ``_rNN`` suffix convention of the root
+artifacts) and ``device`` (the device kind the run measured on — the
+ledger refuses to compare rounds across devices, so emitters that know
+their device must say so). Both are optional: schema-1 records load
+unchanged and the ledger falls back to filename/round heuristics.
 """
 
 from __future__ import annotations
@@ -20,11 +28,32 @@ import dataclasses
 import json
 import os
 import platform
+import re
 import time
 from typing import Any, Dict, Optional
 
 #: bump on any backward-incompatible field change; consumers key on this
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+
+def round_from_name(path: str) -> Optional[int]:
+    """The measurement round encoded in an artifact filename (the
+    ``_rNN`` convention: BENCH_r05.json -> 5), or None."""
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def current_device() -> Optional[str]:
+    """Best-effort device kind for the envelope ``device`` field:
+    the first device's ``device_kind`` (falls back to platform name).
+    Touches ``jax.devices()`` — callers that must not initialize a
+    backend should pass ``device`` explicitly instead."""
+    try:
+        import jax
+        dev = jax.devices()[0]
+        return str(getattr(dev, "device_kind", None) or dev.platform)
+    except Exception:
+        return None
 
 
 def _host_context() -> Dict[str, Any]:
@@ -56,6 +85,8 @@ class RunRecord:
     counters: Optional[Dict[str, Any]] = None
     comms: Optional[Dict[str, Any]] = None
     artifacts: Dict[str, str] = dataclasses.field(default_factory=dict)
+    round: Optional[int] = None      # schema 2: measurement round (_rNN)
+    device: Optional[str] = None     # schema 2: device kind measured on
     schema: int = SCHEMA_VERSION
     created_unix: float = dataclasses.field(default_factory=time.time)
     host: Dict[str, Any] = dataclasses.field(default_factory=_host_context)

@@ -120,6 +120,15 @@ class Tracer:
     capture — span JSON stays available either way).
     """
 
+    #: the tracer's clock domain: per-process ``time.perf_counter`` is
+    #: monotonic but has a process-private epoch — timestamps from two
+    #: "monotonic" traces are NOT comparable until a merge aligns them
+    #: on a shared sync event (tools/merge_traces.py then stamps the
+    #: merged doc "synced"). Exported in trace metadata so downstream
+    #: skew analysis can refuse mixed clock domains instead of
+    #: producing nonsense numbers.
+    clock_source = "monotonic"
+
     def __init__(self, annotate: bool = False,
                  profile_dir: Optional[str] = None):
         self._events: List[dict] = []
@@ -184,7 +193,8 @@ class Tracer:
                  "args": {"name": process_name}}]
         with self._lock:
             events = meta + list(self._events)
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "clock": {"source": self.clock_source}}
 
     def write(self, path: str, process_name: str = "dmlp_tpu") -> None:
         if self._profiling:

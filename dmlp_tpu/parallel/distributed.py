@@ -353,6 +353,8 @@ def sharded_solve_from_file(path: str, engine):
     with staging_for_k(engine, kmax):
         ga, gl, gi, gq = place_global_inputs(engine, parsed)
         top = engine.solve_global(ga, gl, gi, gq, kmax)
+    from dmlp_tpu.engine.single import flush_measured_iters
+    flush_measured_iters(engine)
     return top, params, ks
 
 
@@ -509,6 +511,12 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
         with obs_span("dist.solve_local_shards", nq=nqs, kmax=kmax) as sp:
             top = engine.solve_local_shards(ga, gl, gi, gq, kmax)
             sp.fence(top.dists)
+        # The fence above synchronized the per-shard solve: drain the
+        # measured extract-iters queue now (scalar readback) so the
+        # multi-host path's counters also report extraction_term=
+        # measured when a probe is installed.
+        from dmlp_tpu.engine.single import flush_measured_iters
+        flush_measured_iters(engine)
         local_s = dict(local, query_attrs=q64_seg)
         with obs_span("dist.rescore_local_shards", nq=nqs):
             my_d, my_l, my_i = rescore_local_shards(

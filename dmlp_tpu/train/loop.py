@@ -373,6 +373,11 @@ def main(argv=None) -> int:
     p.add_argument("--ckpt-every", type=int, default=100)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--metrics-file", default=None)
+    p.add_argument("--record", metavar="FILE", default=None,
+                   help="write one versioned RunRecord (obs.run) "
+                        "summarizing the run to FILE — the "
+                        "ledger-ingestible train artifact "
+                        "(python -m dmlp_tpu.report)")
     p.add_argument("--trace", metavar="FILE", default=None,
                    help="write a Perfetto/Chrome-trace JSON of the run's "
                         "step/checkpoint spans to FILE (obs.trace)")
@@ -415,6 +420,28 @@ def main(argv=None) -> int:
             from dmlp_tpu.obs import trace as obs_trace
             tracer.write(args.trace)
             obs_trace.uninstall()
+    if args.record:
+        from dmlp_tpu.obs.run import (RunRecord, current_device,
+                                      round_from_name)
+        artifacts = {}
+        if args.trace:
+            artifacts["trace"] = args.trace
+        if args.metrics_file:
+            artifacts["metrics"] = args.metrics_file
+        RunRecord(
+            kind="train", tool="dmlp_tpu.train",
+            config={"parallelism": args.parallelism,
+                    "dims": [int(d) for d in args.dims.split(",")],
+                    "batch": args.batch, "steps": args.steps,
+                    "mesh": mesh_shape and list(mesh_shape),
+                    "optimizer": args.optimizer,
+                    "compute_dtype": args.compute_dtype,
+                    "offload": args.offload,
+                    "moe_dispatch": args.moe_dispatch,
+                    "pp_schedule": args.pp_schedule},
+            metrics=dict(last), artifacts=artifacts,
+            device=current_device(),
+            round=round_from_name(args.record)).write(args.record)
     print(f"final: {last}")
     return 0
 

@@ -69,7 +69,8 @@ def test_run_config_profile_marker_on_cpu(tiny_cfg, tmp_path):
     assert res["checksums_match"]
     assert "profile_unavailable" in buf.getvalue()
     rec = json.loads(open(record_path).read().splitlines()[-1])
-    assert rec["schema"] == 1
+    from dmlp_tpu.obs.run import SCHEMA_VERSION
+    assert rec["schema"] == SCHEMA_VERSION
     assert rec["metrics"]["profile_unavailable"]
     assert "profile" not in rec.get("artifacts", {})
 

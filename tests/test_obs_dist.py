@@ -542,3 +542,127 @@ def test_contract_run_with_dist_tracer_records_solve_span(tmp_path):
     assert "dist.solve" in names
     assert "dist.rescore_local_shards" in names
     assert any(n.startswith("sharded.") for n in names)  # engine spans too
+
+
+# ---------------------------------------------------------------------------
+# straggler/skew analysis + clock-domain metadata (perf-ledger PR)
+# ---------------------------------------------------------------------------
+
+def _write_rank_with_solve_dur(tmp_path, rank, num_ranks, solve_dur_us,
+                               clock_source=None):
+    """Synthetic rank file with a controllable dist.solve duration and
+    (optionally) an explicit clock-domain declaration."""
+    doc = {
+        "dist": {"rank": rank, "num_ranks": num_ranks,
+                 "clock_sync_ts_us": 100.0},
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": rank, "tid": 0,
+             "args": {"name": f"rank {rank}"}},
+            {"ph": "i", "name": "dist.clock_sync", "ts": 100.0,
+             "pid": rank, "tid": 0, "s": "p"},
+            {"ph": "X", "name": "dist.solve", "ts": 110.0,
+             "dur": solve_dur_us, "pid": rank, "tid": 0},
+        ],
+    }
+    if clock_source is not None:
+        doc["clock"] = {"source": clock_source}
+    with open(tmp_path / f"trace-rank{rank:02d}.json", "w") as f:
+        json.dump(doc, f)
+
+
+def test_tracer_exports_clock_source_metadata():
+    doc = obs_trace.Tracer().to_dict()
+    assert doc["clock"] == {"source": "monotonic"}
+    ddoc = dist_trace.DistTracer(rank=0, num_ranks=1).to_dict()
+    assert ddoc["clock"] == {"source": "monotonic"}
+    assert ddoc["dist"]["clock_source"] == "monotonic"
+
+
+def test_merge_embeds_straggler_table_and_flags(tmp_path):
+    # rank 1's solve is 3x the median -> flagged at the 1.5x default
+    _write_rank_with_solve_dur(tmp_path, 0, 3, 1000.0)
+    _write_rank_with_solve_dur(tmp_path, 1, 3, 3000.0)
+    _write_rank_with_solve_dur(tmp_path, 2, 3, 1000.0)
+    merge_traces = _load_tool("merge_traces")
+    doc = merge_traces.merge(str(tmp_path))
+    st = doc["dist"]["straggler"]
+    assert st["flagged_ranks"] == [1]
+    assert st["per_rank"]["1"]["skew_vs_median"] == pytest.approx(3.0)
+    assert st["per_rank"]["0"]["skew_vs_median"] == pytest.approx(1.0)
+    assert doc["clock"] == {"source": "synced"}
+
+    # balanced ranks -> nothing flagged
+    for rank in range(3):
+        _write_rank_with_solve_dur(tmp_path, rank, 3, 1000.0)
+    st2 = merge_traces.merge(str(tmp_path))["dist"]["straggler"]
+    assert st2["flagged_ranks"] == []
+
+
+def test_straggler_refuses_mixed_clock_domains(tmp_path):
+    _write_rank_with_solve_dur(tmp_path, 0, 2, 1000.0,
+                               clock_source="synced")
+    _write_rank_with_solve_dur(tmp_path, 1, 2, 9000.0)  # monotonic default
+    merge_traces = _load_tool("merge_traces")
+    st = merge_traces.merge(str(tmp_path))["dist"]["straggler"]
+    assert "straggler_unavailable" in st
+    assert "mixed clock domains" in st["straggler_unavailable"]
+    assert "flagged_ranks" not in st   # no nonsense numbers alongside
+
+
+def test_check_dist_trace_emits_skew_table_json(tmp_path):
+    for rank in range(2):
+        _write_rank_with_solve_dur(tmp_path, rank, 2, 1000.0)
+    merge_traces = _load_tool("merge_traces")
+    merged = tmp_path / "merged.json"
+    with open(merged, "w") as f:
+        json.dump(merge_traces.merge(str(tmp_path)), f)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_trace.py"),
+         "--dist", str(merged), "--ranks", "2", "--json"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60)
+    assert proc.returncode == 0, proc.stderr.decode()
+    verdict = json.loads(proc.stdout.decode())  # stdout is pure JSON
+    assert set(verdict["straggler"]["per_rank"]) == {"0", "1"}
+    assert verdict["spans_per_rank"] == {"0": 1, "1": 1}
+
+
+def test_check_dist_trace_fail_on_straggler_opt_in(tmp_path):
+    _write_rank_with_solve_dur(tmp_path, 0, 2, 1000.0)
+    _write_rank_with_solve_dur(tmp_path, 1, 2, 9000.0)
+    merge_traces = _load_tool("merge_traces")
+    merged = tmp_path / "merged.json"
+    with open(merged, "w") as f:
+        json.dump(merge_traces.merge(str(tmp_path)), f)
+    argv = [sys.executable, os.path.join(REPO, "tools", "check_trace.py"),
+            "--dist", str(merged), "--ranks", "2"]
+    assert subprocess.run(argv, capture_output=True,
+                          timeout=60).returncode == 0   # report-only
+    proc = subprocess.run(argv + ["--fail-on-straggler"],
+                          capture_output=True, timeout=60)
+    assert proc.returncode == 1
+    assert b"straggler" in proc.stderr
+
+
+def test_sharded_engine_reports_measured_extraction_term():
+    """The mesh fold outputs now carry per-shard kernel iters: a probed
+    ShardedEngine extract run reports extraction_term=measured (the
+    ROADMAP follow-on from the autotuner PR)."""
+    from dmlp_tpu.config import EngineConfig
+    from dmlp_tpu.engine.sharded import ShardedEngine
+    from dmlp_tpu.io.datagen import generate_input_text
+    from dmlp_tpu.io.grammar import parse_input_text
+
+    inp = parse_input_text(
+        generate_input_text(512, 24, 6, 0.0, 20.0, 1, 8, 3, seed=11))
+    eng = ShardedEngine(
+        EngineConfig(mode="sharded", select="extract", use_pallas=True))
+    probe = obs_counters.install()
+    try:
+        eng.run(inp)
+    finally:
+        obs_counters.uninstall()
+    got = probe.collect()
+    assert got.get("extraction_term") == "measured", got
+    assert got.get("extract_iters_total", 0) > 0
+    site = got["per_site"]["sharded.chunk_fold"]
+    assert site["extraction_term"] == "measured"

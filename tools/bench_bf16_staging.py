@@ -8,8 +8,10 @@ methodology, so link weather hits both equally), verifies both produce
 IDENTICAL results query-for-query, and reports the bf16 tie-overflow
 repair rate (bf16's coarser distances make boundary ties more frequent).
 
-Writes BENCH_BF16_r04.json. Env: BENCH_REPS (default 5), shape knobs as
-in bench.py, BENCH_OUT.
+Writes a schema RunRecord (obs.run) to BENCH_BF16_r06.json — the
+ledger-ingestible artifact form (python -m dmlp_tpu.report); the r04
+ad-hoc shape is grandfathered. Env: BENCH_REPS (default 5), shape knobs
+as in bench.py, BENCH_OUT.
 """
 
 from __future__ import annotations
@@ -39,7 +41,7 @@ def main() -> int:
     num_attrs = _env_int("BENCH_NUM_ATTRS", 64)
     k = _env_int("BENCH_K", 32)
     reps = _env_int("BENCH_REPS", 5)
-    out_path = os.environ.get("BENCH_OUT", "BENCH_BF16_r04.json")
+    out_path = os.environ.get("BENCH_OUT", "BENCH_BF16_r06.json")
 
     inp = make_workload(num_data, num_queries, num_attrs, k)
     use_pallas = native_pallas_backend()
@@ -70,39 +72,33 @@ def main() -> int:
             times[name].append(round((time.perf_counter() - t0) * 1e3, 1))
             repairs[name].append(getattr(engines[name], "last_repairs", None))
 
-    doc = {
-        "note": "Exact-mode (f64 host rescore) end-to-end engine.run(), "
-                "f32-staged vs bf16-staged, interleaved A/B reps "
-                "(alternating order) on the tunneled link. bf16 halves "
-                "the staged attr bytes; the f64 rescore over the deep "
-                "bf16 candidate window (resolve_kcap) plus the eps-aware "
-                "truncation test (finalize.staging_eps) make the result "
-                "provably identical — 'results_identical' verifies it "
-                "query-for-query; repairs counts oracle-repair "
-                "fallbacks. The win tracks link weather: halved upload "
-                "dominates on a slow link, while on a fast one the "
-                "deeper window's wider readback offsets part of it.",
-        "shape": {"num_data": num_data, "num_queries": num_queries,
-                  "num_attrs": num_attrs, "k": k},
-        "platform": jax.devices()[0].platform,
-        "use_pallas": use_pallas,
-        "results_identical": bool(parity),
-        "runs": [
-            {"staging": name,
-             "median_ms": float(np.median(times[name])),
-             "min_ms": float(np.min(times[name])),
-             "max_ms": float(np.max(times[name])),
-             "times_ms": times[name],
-             "repairs": repairs[name],
-             "select": getattr(engines[name], "_last_select", None),
-             "staged_attr_mb": round(
-                 (num_data + num_queries) * num_attrs
-                 * (2 if name == "bf16" else 4) / 1e6, 1)}
-            for name in names
-        ],
-    }
-    with open(out_path, "w") as f:
-        json.dump(doc, f, indent=1)
+    from dmlp_tpu.obs.run import RunRecord, current_device, round_from_name
+
+    metrics = {"results_identical": bool(parity)}
+    for name in names:
+        metrics[f"{name}_median_ms"] = float(np.median(times[name]))
+        metrics[f"{name}_min_ms"] = float(np.min(times[name]))
+        metrics[f"{name}_times_ms"] = times[name]
+        metrics[f"{name}_repairs"] = repairs[name]
+        metrics[f"{name}_staged_attr_mb"] = round(
+            (num_data + num_queries) * num_attrs
+            * (2 if name == "bf16" else 4) / 1e6, 1)
+    RunRecord(
+        kind="bench", tool="tools.bench_bf16_staging",
+        config={"note": "Exact-mode (f64 host rescore) end-to-end "
+                        "engine.run(), f32-staged vs bf16-staged, "
+                        "interleaved A/B reps (alternating order) on "
+                        "the tunneled link; results_identical verifies "
+                        "query-for-query parity, repairs counts "
+                        "oracle-repair fallbacks.",
+                "num_data": num_data, "num_queries": num_queries,
+                "num_attrs": num_attrs, "k": k, "reps": reps,
+                "use_pallas": use_pallas,
+                "platform": jax.devices()[0].platform,
+                "select": {n: getattr(engines[n], "_last_select", None)
+                           for n in names}},
+        metrics=metrics, device=current_device(),
+        round=round_from_name(out_path)).write(out_path)
     print(json.dumps({n: {"median_ms": float(np.median(times[n])),
                           "min_ms": float(np.min(times[n]))}
                       for n in names} | {"identical": bool(parity)}))

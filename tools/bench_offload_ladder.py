@@ -11,8 +11,10 @@ fact, not a claim:
     most HBM ........ least HBM
     fastest ......... stream-bound
 
-Writes TRAINBENCH_r04_ladder.json. Env: TRAIN_DIMS, TRAIN_BATCH,
-TRAIN_STEPS, TRAIN_DTYPE, BENCH_OUT as in train.bench.
+Writes a schema RunRecord (obs.run) to TRAINBENCH_r06_ladder.json —
+ledger-ingestible (python -m dmlp_tpu.report); the r04 ad-hoc shape is
+grandfathered. Env: TRAIN_DIMS, TRAIN_BATCH, TRAIN_STEPS, TRAIN_DTYPE,
+BENCH_OUT as in train.bench.
 """
 
 from __future__ import annotations
@@ -43,7 +45,7 @@ def main() -> int:
     batch = _env_int("TRAIN_BATCH", 32768)
     steps = _env_int("TRAIN_STEPS", 30)
     dtype = os.environ.get("TRAIN_DTYPE", "bfloat16")
-    out_path = os.environ.get("BENCH_OUT", "TRAINBENCH_r04_ladder.json")
+    out_path = os.environ.get("BENCH_OUT", "TRAINBENCH_r06_ladder.json")
     cdtype = jnp.bfloat16 if dtype == "bfloat16" else None
 
     mesh = make_train_mesh(None)
@@ -94,24 +96,30 @@ def main() -> int:
         print(json.dumps(rows[-1]), flush=True)
         del state
 
-    doc = {
-        "note": "Host-DRAM offload ladder at one shape (same batch for "
-                "every level): 'params' keeps optimizer moments "
-                "HBM-resident, halving the per-step stream bytes of "
-                "'all'; the step streams exactly the host-resident "
-                "leaves (train.step.make_train_step). streamed_bytes is "
-                "the one-way host->HBM traffic per step (updates write "
-                "the same bytes back).",
-        "shape": {"dims": list(dims), "batch": batch, "steps": steps,
-                  "dtype": dtype, "n_chips": int(n_chips),
-                  "device_kind": getattr(jax.devices()[0], "device_kind",
-                                         "?")},
-        "injit_offload": bool(supports_injit_offload()),
-        "peak_tflops_per_chip": round(peak_flops_per_chip() / 1e12, 1),
-        "levels": rows,
-    }
-    with open(out_path, "w") as f:
-        json.dump(doc, f, indent=1)
+    from dmlp_tpu.obs.run import RunRecord, round_from_name
+
+    metrics: dict = {}
+    for row in rows:
+        lvl = row["offload"]
+        for key in ("step_time_ms", "mfu", "samples_per_sec_per_chip",
+                    "streamed_bytes_each_way"):
+            metrics[f"{lvl}_{key}"] = row[key]
+    RunRecord(
+        kind="train", tool="tools.bench_offload_ladder",
+        config={"note": "Host-DRAM offload ladder at one shape (same "
+                        "batch for every level): 'params' keeps "
+                        "optimizer moments HBM-resident, halving the "
+                        "per-step stream bytes of 'all'; streamed_bytes "
+                        "is the one-way host->HBM traffic per step.",
+                "dims": list(dims), "batch": batch, "steps": steps,
+                "dtype": dtype, "n_chips": int(n_chips),
+                "injit_offload": bool(supports_injit_offload()),
+                "peak_tflops_per_chip":
+                    round(peak_flops_per_chip() / 1e12, 1)},
+        metrics=metrics,
+        device=str(getattr(jax.devices()[0], "device_kind",
+                           jax.devices()[0].platform)),
+        round=round_from_name(out_path)).write(out_path)
     print(json.dumps({"written": out_path}))
     return 0
 

@@ -18,8 +18,10 @@ Correctness: exact mode (f64 rescore + eps-hazard repair) end-to-end;
 additionally VALIDATE_QUERIES queries are solved by the vectorized f64
 oracle over the full 64M rows and diffed checksum-for-checksum.
 
-Writes CAPACITY_BEYOND_HBM_r04.json. Env: CAP_NUM_DATA, CAP_NUM_QUERIES,
-CAP_VALIDATE (default 8), BENCH_OUT.
+Writes a schema RunRecord (obs.run) to CAPACITY_BEYOND_HBM_r06.json —
+ledger-ingestible (python -m dmlp_tpu.report); the r04 ad-hoc shape is
+grandfathered. Env: CAP_NUM_DATA, CAP_NUM_QUERIES, CAP_VALIDATE
+(default 8), BENCH_OUT.
 """
 
 from __future__ import annotations
@@ -51,7 +53,7 @@ def main() -> int:
     nq = int(os.environ.get("CAP_NUM_QUERIES", 2048))
     nv = int(os.environ.get("CAP_VALIDATE", 8))
     na, k = 64, 32
-    out_path = os.environ.get("BENCH_OUT", "CAPACITY_BEYOND_HBM_r04.json")
+    out_path = os.environ.get("BENCH_OUT", "CAPACITY_BEYOND_HBM_r06.json")
 
     dev = jax.devices()[0]
     hbm_bytes = 0
@@ -93,35 +95,38 @@ def main() -> int:
         for q, g in zip(vidx, golden))
     validate_s = time.perf_counter() - t0
 
+    from dmlp_tpu.obs.run import RunRecord, round_from_name
+
     dataset_bytes = n * na * 4
-    doc = {
-        "note": "Chunked extract solve of a dataset LARGER than HBM: only "
-                "in-flight chunks (window-throttled), queries, and the "
-                "running lists are device-resident. Exact mode end-to-end; "
-                f"{nv} queries validated checksum-for-checksum against the "
-                "vectorized f64 oracle over the full dataset. wall_s is "
-                "staging-bound on the tunneled link (the dataset crosses "
-                "the host link once, in ~51k-row chunks overlapped with "
-                "the folds).",
-        "device_kind": getattr(dev, "device_kind", "?"),
-        "num_data": n, "num_queries": nq, "num_attrs": na, "kmax": k,
-        "dataset_bytes_f32": dataset_bytes,
-        "hbm_bytes": hbm_bytes,
-        "dataset_vs_hbm": round(dataset_bytes / hbm_bytes, 3),
-        "select": eng._last_select,
-        "repairs": eng.last_repairs,
-        "gen_s": round(gen_s, 1),
-        "solve_wall_s": round(solve_s, 1),
-        "qd_pairs_per_sec_wall": int(n * nq / solve_s),
-        "phases_ms": {m: round(v, 1)
-                      for m, v in eng.last_phase_ms.items()},
-        "validated_queries": nv,
-        "validate_mismatches": int(mismatches),
-        "validate_s": round(validate_s, 1),
-    }
-    with open(out_path, "w") as f:
-        json.dump(doc, f, indent=1)
-    print(json.dumps(doc))
+    rec = RunRecord(
+        kind="capacity", tool="tools.capacity_beyond_hbm",
+        config={"note": "Chunked extract solve of a dataset LARGER than "
+                        "HBM: only in-flight chunks (window-throttled), "
+                        "queries, and the running lists are "
+                        "device-resident. Exact mode end-to-end; "
+                        f"{nv} queries validated checksum-for-checksum "
+                        "against the vectorized f64 oracle. wall_s is "
+                        "staging-bound on the tunneled link.",
+                "num_data": n, "num_queries": nq, "num_attrs": na,
+                "kmax": k, "select": eng._last_select,
+                "dataset_bytes_f32": dataset_bytes,
+                "hbm_bytes": hbm_bytes},
+        metrics={
+            "dataset_vs_hbm": round(dataset_bytes / hbm_bytes, 3),
+            "repairs": eng.last_repairs,
+            "gen_s": round(gen_s, 1),
+            "solve_wall_s": round(solve_s, 1),
+            "qd_pairs_per_sec_wall": int(n * nq / solve_s),
+            "phases_ms": {m: round(v, 1)
+                          for m, v in eng.last_phase_ms.items()},
+            "validated_queries": nv,
+            "validate_mismatches": int(mismatches),
+            "validate_s": round(validate_s, 1),
+        },
+        device=str(getattr(dev, "device_kind", dev.platform)),
+        round=round_from_name(out_path))
+    rec.write(out_path)
+    print(rec.to_json())
     return 0 if mismatches == 0 else 1
 
 

@@ -14,21 +14,30 @@ structural contract the obs subsystem promises —
   either cost-analysis flops/bytes or the explicit
   ``counters_unavailable`` marker — never silence.
 
-``--dist MERGED.json [--ranks N]`` instead validates a merged multi-rank
-cluster trace (tools/merge_traces.py output): the expected number of
-distinct rank pids, per-rank process metadata events and clock-sync
-markers, per-rank spans including the contract ``dist.solve`` span,
-monotonic (sorted, non-negative) per-rank timestamps after alignment,
-and — when the merge embedded a ``comms_reconcile`` block — agreement
-between each rank's traced all-gather payload bytes and the analytic
-model (obs.comms): any rank whose two numbers disagree FAILS the check
-(per-rank flagging of the analytic-vs-traced reconciliation) — the
-`make obs-dist-smoke` checker.
+``--dist MERGED.json [--ranks N] [--json]`` instead validates a merged
+multi-rank cluster trace (tools/merge_traces.py output): the expected
+number of distinct rank pids, per-rank process metadata events and
+clock-sync markers, per-rank spans including the contract
+``dist.solve`` span, monotonic (sorted, non-negative) per-rank
+timestamps after alignment, and — when the merge embedded a
+``comms_reconcile`` block — agreement between each rank's traced
+all-gather payload bytes and the analytic model (obs.comms): any rank
+whose two numbers disagree FAILS the check (per-rank flagging of the
+analytic-vs-traced reconciliation) — the `make obs-dist-smoke`
+checker. The merge's per-rank ``straggler`` skew table (span-duration
+skew vs the across-rank median, flagged ranks beyond the threshold) is
+validated structurally and printed; ``--json`` emits the whole verdict
+— ranks, spans per rank, the skew table, flagged stragglers — as one
+machine-readable JSON document on stdout. Straggler flags REPORT, they
+do not fail: emulated/CI ranks legitimately skew (sequential launch),
+and the gate for real clusters is a policy call made downstream
+(``--fail-on-straggler`` opts in).
 
 Exit 0 on success, 1 with a message naming the first violated invariant.
 
 Usage: python tools/check_trace.py TRACE.json METRICS.jsonl
        python tools/check_trace.py --dist MERGED.json [--ranks N]
+           [--json] [--fail-on-straggler]
 """
 
 from __future__ import annotations
@@ -105,9 +114,16 @@ def check_metrics(path: str) -> None:
                   f"bytes={counters['bytes_accessed']:.4g}"))
 
 
-def check_dist_trace(path: str, expect_ranks: int = None) -> None:
+def check_dist_trace(path: str, expect_ranks: int = None,
+                     emit_json: bool = False,
+                     fail_on_straggler: bool = False) -> None:
     """Structural contract of a merged multi-rank trace
     (tools/merge_traces.py output)."""
+    # With --json, stdout carries ONLY the JSON document; the human
+    # narration moves to stderr so consumers can json.loads(stdout).
+    def say(msg: str) -> None:
+        print(msg, file=sys.stderr if emit_json else sys.stdout)
+
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -169,15 +185,57 @@ def check_dist_trace(path: str, expect_ranks: int = None) -> None:
                      f"{e.get('analytic_bytes')}) — the comms model "
                      "(obs.comms) and the real payload have diverged")
             if "analytic_unavailable" in e:
-                print(f"check_trace: note — rank {rank} comms "
+                say(f"check_trace: note — rank {rank} comms "
                       f"reconciliation unavailable: "
                       f"{e['analytic_unavailable']}")
         ok_ranks = [r for r, e in reconcile.items() if e.get("match")]
         if ok_ranks:
-            print(f"check_trace: comms reconcile ok — analytic == traced "
+            say(f"check_trace: comms reconcile ok — analytic == traced "
                   f"all-gather bytes for rank(s) {sorted(ok_ranks)}")
     counts = {pid: len(spans_by_pid[pid]) for pid in pids}
-    print(f"check_trace: merged trace ok — {n} ranks, spans per rank "
+
+    # -- straggler/skew table (merge_traces.straggler_analysis) ---------
+    straggler = doc.get("dist", {}).get("straggler")
+    if isinstance(straggler, dict):
+        if "straggler_unavailable" in straggler:
+            say(f"check_trace: note — straggler analysis unavailable: "
+                  f"{straggler['straggler_unavailable']}")
+        else:
+            per_rank = straggler.get("per_rank")
+            if not isinstance(per_rank, dict) or \
+                    sorted(int(r) for r in per_rank) != pids:
+                fail(f"merged trace {path}: straggler block's rank set "
+                     f"{sorted(per_rank or {})} does not match the "
+                     f"trace's ranks {pids}")
+            for rank, row in sorted(per_rank.items()):
+                for key in ("span_busy_ms", "solve_ms",
+                            "skew_vs_median"):
+                    if key not in row:
+                        fail(f"merged trace {path}: straggler row for "
+                             f"rank {rank} missing {key!r}")
+            flagged = straggler.get("flagged_ranks", [])
+            table = {r: {"solve_ms": row["solve_ms"],
+                         "skew": row["skew_vs_median"]}
+                     for r, row in sorted(per_rank.items())}
+            say(f"check_trace: straggler skew table (threshold "
+                  f"{straggler.get('threshold')}x median "
+                  f"{straggler.get('median_solve_ms')} ms): {table}")
+            if flagged:
+                msg = (f"rank(s) {flagged} beyond the straggler "
+                       f"threshold")
+                if fail_on_straggler:
+                    fail(f"merged trace {path}: {msg}")
+                say(f"check_trace: WARNING — {msg}")
+
+    if emit_json:
+        print(json.dumps({
+            "trace": path, "ranks": n,
+            "spans_per_rank": {str(p): counts[p] for p in pids},
+            "clock": doc.get("clock"),
+            "straggler": straggler,
+            "comms_reconcile": doc.get("dist", {}).get("comms_reconcile"),
+        }, sort_keys=True))
+    say(f"check_trace: merged trace ok — {n} ranks, spans per rank "
           f"{counts}")
 
 
@@ -186,6 +244,12 @@ def main(argv=None) -> int:
     if argv and argv[0] == "--dist":
         rest = argv[1:]
         expect = None
+        emit_json = "--json" in rest
+        if emit_json:
+            rest.remove("--json")
+        fail_straggler = "--fail-on-straggler" in rest
+        if fail_straggler:
+            rest.remove("--fail-on-straggler")
         if "--ranks" in rest:
             i = rest.index("--ranks")
             try:
@@ -199,8 +263,11 @@ def main(argv=None) -> int:
         if len(rest) != 1:
             print(__doc__, file=sys.stderr)
             return 2
-        check_dist_trace(rest[0], expect_ranks=expect)
-        print("check_trace: all merged-trace invariants hold")
+        check_dist_trace(rest[0], expect_ranks=expect,
+                         emit_json=emit_json,
+                         fail_on_straggler=fail_straggler)
+        print("check_trace: all merged-trace invariants hold",
+              file=sys.stderr if emit_json else sys.stdout)
         return 0
     if len(argv) != 2:
         print(__doc__, file=sys.stderr)

@@ -27,12 +27,21 @@ epoch (``time.perf_counter`` is per-process) and its rank as the Perfetto
    (``comms_reconcile``); ``tools/check_trace.py --dist`` fails on any
    rank whose two numbers disagree. Pre-r6 traces without the shape
    args get an explicit ``analytic_unavailable`` marker, not a failure;
-5. writes one merged Chrome-trace JSON, events sorted by aligned ``ts``
+5. analyzes per-rank span-duration skew (the straggler detector): each
+   rank's total ``dist.solve`` duration vs the across-rank median,
+   flagging ranks beyond ``--straggler-threshold`` (default 1.5x) in
+   the merged ``dist.straggler`` block. Rank files from MIXED clock
+   domains (the trace metadata's ``clock.source`` — "monotonic" raw
+   per-process tracers vs an already-"synced" merged doc) are refused
+   with an explicit ``straggler_unavailable`` marker instead of
+   nonsense skew numbers;
+6. writes one merged Chrome-trace JSON, events sorted by aligned ``ts``
    (per-rank monotonicity is then checkable by tools/check_trace.py
-   --dist), with distinct pids so ui.perfetto.dev renders one process
-   track per rank.
+   --dist), stamped ``clock.source: "synced"``, with distinct pids so
+   ui.perfetto.dev renders one process track per rank.
 
 Usage: python tools/merge_traces.py DIR [-o MERGED.json] [--no-align]
+       [--straggler-threshold X]
 Exit 0 on success; 1 with a message naming the violated invariant.
 """
 
@@ -156,7 +165,67 @@ def reconcile_comms(docs) -> dict | None:
     return per_rank or None
 
 
-def merge(trace_dir: str, align: bool = True) -> dict:
+def _rank_clock_source(doc) -> str:
+    """The rank file's declared clock domain; pre-r6 traces (no clock
+    metadata) are per-process monotonic by construction."""
+    src = (doc.get("clock") or {}).get("source")
+    if src is None:
+        src = (doc.get("dist") or {}).get("clock_source")
+    return src or "monotonic"
+
+
+def straggler_analysis(docs, threshold: float = 1.5) -> dict:
+    """Per-rank span-duration skew table — the straggler detector.
+
+    Durations (``dur``) are clock-OFFSET invariant, so the skew metric
+    compares each rank's total ``dist.solve`` time (the contract solve
+    every rank dispatches identically) and total span-busy time against
+    the across-rank median; a rank whose solve time exceeds
+    ``threshold`` x the median is flagged. Ranks from MIXED clock
+    domains (one trace already merge-aligned/"synced", another raw
+    "monotonic" — their timestamps AND tick provenance differ) are
+    refused with an explicit ``straggler_unavailable`` marker instead
+    of a nonsense table.
+    """
+    domains = {rank: _rank_clock_source(doc) for rank, doc in docs}
+    if len(set(domains.values())) > 1:
+        return {"straggler_unavailable":
+                f"mixed clock domains {domains} — re-record all ranks "
+                "with one tracer generation before skew-comparing"}
+    per_rank = {}
+    solve_ms = {}
+    for rank, doc in docs:
+        busy = solve = 0.0
+        last_end = 0.0
+        for e in doc.get("traceEvents", []):
+            if e.get("ph") != "X":
+                continue
+            dur = float(e.get("dur", 0.0))
+            busy += dur
+            last_end = max(last_end, float(e.get("ts", 0.0)) + dur)
+            if e.get("name") == "dist.solve":
+                solve += dur
+        solve_ms[rank] = solve / 1e3
+        per_rank[str(rank)] = {"span_busy_ms": round(busy / 1e3, 3),
+                               "solve_ms": round(solve / 1e3, 3),
+                               "last_span_end_ms":
+                                   round(last_end / 1e3, 3)}
+    import statistics
+    med = statistics.median(solve_ms.values())
+    flagged = []
+    for rank in sorted(solve_ms):
+        skew = (solve_ms[rank] / med) if med > 0 else None
+        per_rank[str(rank)]["skew_vs_median"] = \
+            round(skew, 3) if skew is not None else None
+        if skew is not None and skew > threshold:
+            flagged.append(rank)
+    return {"threshold": threshold, "clock_source": domains[docs[0][0]],
+            "median_solve_ms": round(med, 3), "per_rank": per_rank,
+            "flagged_ranks": flagged}
+
+
+def merge(trace_dir: str, align: bool = True,
+          straggler_threshold: float = 1.5) -> dict:
     docs = load_rank_files(trace_dir)
     offsets = {}
     if align:
@@ -219,9 +288,21 @@ def merge(trace_dir: str, align: bool = True) -> dict:
             print(f"merge_traces: WARNING: analytic vs traced all-gather "
                   f"bytes disagree for rank(s) {bad}: "
                   f"{ {r: reconcile[r] for r in bad} }", file=sys.stderr)
+    straggler = straggler_analysis(docs, threshold=straggler_threshold)
+    dist_block["straggler"] = straggler
+    if straggler.get("flagged_ranks"):
+        print(f"merge_traces: WARNING: rank(s) "
+              f"{straggler['flagged_ranks']} exceed "
+              f"{straggler['threshold']}x the median dist.solve time "
+              f"(median {straggler['median_solve_ms']} ms) — straggler/"
+              "skew suspects", file=sys.stderr)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
+        # Post-alignment, all ranks share one timeline; downstream skew
+        # consumers key on this (a re-merge of this doc must not
+        # re-align or mix it with raw monotonic rank files).
+        "clock": {"source": "synced" if align else "monotonic"},
         "dist": dist_block,
     }
 
@@ -234,10 +315,14 @@ def main(argv=None) -> int:
     ap.add_argument("--no-align", action="store_true",
                     help="keep each rank's raw clock (skip the "
                          "clock-sync offset alignment)")
+    ap.add_argument("--straggler-threshold", type=float, default=1.5,
+                    help="flag ranks whose dist.solve time exceeds this "
+                         "multiple of the across-rank median")
     args = ap.parse_args(argv)
 
     out_path = args.out or os.path.join(args.trace_dir, "trace-merged.json")
-    doc = merge(args.trace_dir, align=not args.no_align)
+    doc = merge(args.trace_dir, align=not args.no_align,
+                straggler_threshold=args.straggler_threshold)
     tmp = out_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f)

@@ -163,6 +163,28 @@ def main(argv=None) -> int:
         proc = subprocess.run(tool_argv, cwd=REPO)
         if proc.returncode != 0:
             return proc.returncode
+
+    # Straggler path: the checker's --json verdict must carry the
+    # per-rank skew table the merge embedded (every rank present, skew
+    # vs the across-rank median computed) — the smoke-level proof that
+    # the straggler detector runs on every merged trace.
+    import json
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_trace.py"),
+         "--dist", merged, "--ranks", str(args.procs), "--json"],
+        cwd=REPO, stdout=subprocess.PIPE)
+    if proc.returncode != 0:
+        return proc.returncode
+    verdict = json.loads(proc.stdout.decode())
+    straggler = verdict.get("straggler") or {}
+    per_rank = straggler.get("per_rank") or {}
+    if sorted(int(r) for r in per_rank) != list(range(args.procs)):
+        print(f"obs_dist_smoke: FAIL: straggler skew table missing or "
+              f"incomplete in the --json verdict: {straggler}",
+              file=sys.stderr)
+        return 1
+    print(f"obs_dist_smoke: straggler skew table ok — "
+          f"{ {r: row.get('skew_vs_median') for r, row in sorted(per_rank.items())} }")
     print(f"obs_dist_smoke: ok — {args.procs}-rank traced run merged and "
           f"validated under {trace_dir}")
     return 0

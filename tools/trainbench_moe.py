@@ -7,8 +7,11 @@ Expert parallelism needs multiple devices, so this runs on the virtual
 8-device CPU mesh (1 real TPU chip cannot host an ep axis) — dispatch-
 relative numbers, like PIPEBENCH.
 
+Writes a schema RunRecord (obs.run) — ledger-ingestible (python -m
+dmlp_tpu.report); the r05 ad-hoc shape is grandfathered.
+
 Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-    python tools/trainbench_moe.py [--out TRAINBENCH_r05_moe.json]
+    python tools/trainbench_moe.py [--out TRAINBENCH_r06_moe.json]
 """
 from __future__ import annotations
 
@@ -25,7 +28,7 @@ import numpy as np
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="TRAINBENCH_r05_moe.json")
+    ap.add_argument("--out", default="TRAINBENCH_r06_moe.json")
     ap.add_argument("--reps", type=int, default=7)
     ap.add_argument("--dp", type=int, default=2)
     ap.add_argument("--ep", type=int, default=4)
@@ -87,22 +90,31 @@ def main() -> int:
             samples[name].append((time.perf_counter() - t0) * 1e3)
             cells[name] = (state, step, xd, yd)
 
-    rec = {"platform": jax.devices()[0].platform,
-           "mesh": [args.dp, args.ep], "experts": args.experts,
-           "hidden": args.hidden, "ffn": args.ffn, "batch": args.batch,
-           "capacity_factor": args.cf, "capacity": cap,
-           "note": "virtual CPU mesh (1 real chip cannot host an ep "
-                   "axis); dispatch-relative step times",
-           "dispatch": {}}
+    from dmlp_tpu.obs.run import RunRecord, current_device, round_from_name
+
+    metrics: dict = {}
     for name, ts in samples.items():
-        rec["dispatch"][name] = {"median_ms": float(np.median(ts)),
-                                 "min_ms": float(np.min(ts))}
-    rec["a2a_vs_dense_pct"] = round(100.0 * (
-        rec["dispatch"]["a2a"]["median_ms"]
-        / rec["dispatch"]["dense"]["median_ms"] - 1), 1)
-    with open(args.out, "w") as f:
-        json.dump(rec, f, indent=1)
-    print(json.dumps(rec, indent=1))
+        metrics[f"{name}_median_ms"] = float(np.median(ts))
+        metrics[f"{name}_min_ms"] = float(np.min(ts))
+        metrics[f"{name}_times_ms"] = [round(t, 3) for t in ts]
+    metrics["a2a_vs_dense_pct"] = round(100.0 * (
+        metrics["a2a_median_ms"] / metrics["dense_median_ms"] - 1), 1)
+    rec = RunRecord(
+        kind="train", tool="tools.trainbench_moe",
+        config={"note": "dense one-hot vs capacity+all-to-all MoE "
+                        "dispatch, interleaved reps with rotating "
+                        "starts; virtual CPU mesh when 1 real chip "
+                        "cannot host an ep axis — dispatch-relative "
+                        "step times",
+                "platform": jax.devices()[0].platform,
+                "mesh": [args.dp, args.ep], "experts": args.experts,
+                "hidden": args.hidden, "ffn": args.ffn,
+                "batch": args.batch, "capacity_factor": args.cf,
+                "capacity": cap, "reps": args.reps},
+        metrics=metrics, device=current_device(),
+        round=round_from_name(args.out))
+    rec.write(args.out)
+    print(json.dumps(json.loads(rec.to_json()), indent=1))
     return 0
 
 
