@@ -6,7 +6,7 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
 .PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
-	perf-gate clean
+	perf-gate check lint clean
 
 all: native
 
@@ -15,8 +15,51 @@ native: native/_fastparse.so
 native/_fastparse.so: native/fastparse.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
-test: obs-smoke obs-dist-smoke tune-smoke perf-gate
+test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint
 	python -m pytest tests/ -q
+
+# Static analysis + runtime-sanitizer smoke (README "Static analysis &
+# sanitizers"): the AST rule families R1-R4 (collective-axis contract,
+# recompilation hazards, host-sync hazards, compat-bypass) over the whole
+# package, gated by check_baseline.json — the committed baseline is EMPTY,
+# so ANY finding fails. Then the runtime half: bench config 1 through the
+# real CLI under DMLP_TPU_SANITIZE=1 (jax.transfer_guard("disallow") +
+# jax.checking_leaks active around the solve) must complete with contract
+# stdout byte-identical to the plain run — the hot path is transfer-clean
+# end to end, with only the annotated explicit device_get fences reading
+# back.
+check:
+	mkdir -p outputs
+	JAX_PLATFORMS=cpu python -m dmlp_tpu.check
+	JAX_PLATFORMS=cpu python -c "from dmlp_tpu.bench.configs import BENCH_CONFIGS; \
+	from dmlp_tpu.bench.harness import ensure_input; \
+	ensure_input(BENCH_CONFIGS[1], 'inputs')"
+	JAX_PLATFORMS=cpu DMLP_TPU_SANITIZE= python -m dmlp_tpu \
+	  < inputs/input1.in \
+	  > outputs/check_plain.out 2> outputs/check_plain.err
+	rm -f outputs/check_sanitized_metrics.jsonl
+	JAX_PLATFORMS=cpu DMLP_TPU_SANITIZE=1 python -m dmlp_tpu \
+	  --trace outputs/check_sanitized_trace.json \
+	  --metrics outputs/check_sanitized_metrics.jsonl \
+	  < inputs/input1.in \
+	  > outputs/check_sanitized.out 2> outputs/check_sanitized.err
+	grep -q "Time taken:" outputs/check_sanitized.err
+	cmp outputs/check_plain.out outputs/check_sanitized.out
+	python tools/check_trace.py outputs/check_sanitized_trace.json \
+	  outputs/check_sanitized_metrics.jsonl
+
+# Generic hygiene (the conservative ruff subset, pyproject [tool.ruff]):
+# ruff when the environment has it, plus the checker's built-in R0
+# family either way — this container ships no ruff, so R0 IS the gate
+# here, over the package, tools, tests, and bench.py.
+lint:
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check dmlp_tpu tools tests bench.py; \
+	else \
+	  echo "ruff not installed; R0 family covers the same rule set"; \
+	fi
+	JAX_PLATFORMS=cpu python -m dmlp_tpu.check --families R0 \
+	  --no-baseline dmlp_tpu tools tests bench.py
 
 # Tier-1 no-regression guard (ROADMAP "Tier-1 verify"): on this
 # container's jax (0.4.37, CPU backend) the suite must hold >= 277

@@ -1,0 +1,55 @@
+"""JAX-aware static analysis for the dmlp_tpu tree (``python -m
+dmlp_tpu.check``).
+
+The repo's three hand-rolled shard_map engines, Pallas kernels, compat
+shims, and analytic comms models must stay mutually consistent — and the
+bug classes that have actually bitten it (variant resolution traced
+inside jit, jax API drift, analytic comms accounting silently diverging
+from the collectives in the code) are exactly the ones a domain-specific
+checker catches before runtime. This package is that checker: an
+AST-based pass (stdlib ``ast``, no dependencies) over the whole package
+enforcing repo-specific rule families:
+
+- **R1 collective-axis contract** (:mod:`.collectives`): every
+  ``psum``/``ppermute``/``all_gather``/``all_to_all``/``axis_index``
+  call site must name a mesh axis declared by an ``*_AXIS`` constant
+  (parallel/mesh.py, train/sharding.py, ...), consistent with the
+  enclosing ``shard_map`` specs; and every traffic-bearing collective in
+  engine/parallel/train code must be mapped to an analytic model in
+  ``obs/comms.py`` via a ``# check: comms-model=<fn>`` annotation.
+- **R2 recompilation hazards** (:mod:`.recompile`): mutable defaults on
+  jitted functions, f-strings and variant/config resolution inside
+  traced bodies (the PR 3 review bug, now a lint), keyword-only params
+  missing from ``static_argnames``, closures over module-level mutables.
+- **R3 host-sync hazards** (:mod:`.hostsync`): ``.item()``,
+  ``jax.device_get``, ``float()``/``int()``/``np.asarray`` on
+  device-producing expressions, and traced-value branches inside
+  ``engine/``, ``ops/``, ``parallel/`` hot paths, with a
+  ``# check: allow-host-sync`` allowlist for the fenced readbacks that
+  are intentional.
+- **R4 compat-bypass** (:mod:`.compatrule`): direct use of drifting jax
+  APIs (``shard_map`` spellings, ``axis_size``, Pallas
+  ``CompilerParams``, host memory-kind strings) anywhere outside
+  ``utils/compat.py``.
+- **R0 hygiene** (:mod:`.hygiene`): the conservative ruff subset
+  (unused imports, bare except, mutable default args, pointless
+  f-strings) so ``make lint`` has teeth even on containers without
+  ruff installed (the pyproject ``[tool.ruff]`` config mirrors it).
+
+Accepted pre-existing findings are pinned in ``check_baseline.json``
+(:mod:`.baseline`); any NEW finding fails ``make check``. The runtime
+side lives in :mod:`.sanitize`: ``DMLP_TPU_SANITIZE=1`` / ``--sanitize``
+wraps solves in ``jax.transfer_guard("disallow")`` +
+``jax.checking_leaks()`` (plus ``debug_nans`` for training) so the hot
+path is provably free of implicit host syncs at runtime too.
+"""
+
+from dmlp_tpu.check.analyzer import analyze_package, analyze_paths
+from dmlp_tpu.check.baseline import diff_baseline, load_baseline, save_baseline
+from dmlp_tpu.check.findings import Finding
+from dmlp_tpu.check.sanitize import sanitize_enabled, sanitized
+
+__all__ = [
+    "Finding", "analyze_package", "analyze_paths", "load_baseline",
+    "save_baseline", "diff_baseline", "sanitize_enabled", "sanitized",
+]

@@ -1,0 +1,123 @@
+"""``python -m dmlp_tpu.check`` — run the static analysis suite.
+
+Exit codes: 0 = clean (or every finding baselined), 1 = new findings,
+2 = usage error. ``--json`` keeps stdout pure JSON (narration goes to
+stderr), matching the ``check_trace --json`` convention so CI can pipe
+the verdict.
+
+Usage::
+
+    python -m dmlp_tpu.check                      # R1-R4 over the package
+    python -m dmlp_tpu.check --families R0        # hygiene only (make lint)
+    python -m dmlp_tpu.check --json               # machine output
+    python -m dmlp_tpu.check --write-baseline     # accept current findings
+    python -m dmlp_tpu.check path/to/file.py ...  # explicit targets
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+from dmlp_tpu.check.analyzer import (ALL_FAMILIES, DEFAULT_FAMILIES,
+                                     analyze_paths, package_root,
+                                     repo_root)
+from dmlp_tpu.check.baseline import (DEFAULT_NAME, diff_baseline,
+                                     load_baseline, save_baseline)
+from dmlp_tpu.check.findings import RULES
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="dmlp_tpu.check",
+                                description=__doc__)
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to analyze (default: the dmlp_tpu "
+                        "package)")
+    p.add_argument("--families", default=None, metavar="R1,R2,...",
+                   help=f"rule families to run (default "
+                        f"{','.join(DEFAULT_FAMILIES)}; all: "
+                        f"{','.join(ALL_FAMILIES)})")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"baseline file (default <repo>/{DEFAULT_NAME} "
+                        f"when analyzing the package)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline: every finding is new")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings as the new baseline "
+                        "and exit 0")
+    p.add_argument("--json", action="store_true",
+                   help="pure-JSON verdict on stdout, narration on "
+                        "stderr")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    families = None
+    if args.families:
+        families = [f.strip() for f in args.families.split(",") if f.strip()]
+        bad = [f for f in families if f not in ALL_FAMILIES]
+        if bad:
+            p.error(f"unknown families {bad}; valid: "
+                    f"{','.join(ALL_FAMILIES)}")
+
+    import os
+    paths = args.paths or [package_root()]
+    baseline_path = args.baseline
+    if baseline_path is None and not args.paths:
+        cand = os.path.join(repo_root(), DEFAULT_NAME)
+        if os.path.exists(cand):
+            baseline_path = cand
+
+    findings = analyze_paths(paths, families)
+
+    if args.write_baseline:
+        out = baseline_path or os.path.join(repo_root(), DEFAULT_NAME)
+        save_baseline(out, findings)
+        print(f"wrote {len(findings)} finding(s) to {out}",
+              file=sys.stderr)
+        return 0
+
+    baseline = load_baseline(baseline_path) \
+        if baseline_path and not args.no_baseline else {}
+    new, matched, stale = diff_baseline(findings, baseline)
+
+    err = sys.stderr
+    if args.json:
+        verdict = {
+            "check_schema": 1,
+            "families": list(families or DEFAULT_FAMILIES),
+            "paths": paths,
+            "baseline": baseline_path,
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.to_dict() for f in new],
+            "baselined": len(matched),
+            "stale_baseline": [
+                {"rule": r, "path": pa, "scope": s, "key": k, "count": n}
+                for (r, pa, s, k), n in sorted(stale.items())],
+            "ok": not new,
+        }
+        json.dump(verdict, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        err = sys.stdout
+    for f in new:
+        print(f"NEW  {f.render()}", file=err)
+    for f in matched:
+        print(f"BASE {f.render()}", file=err)
+    for (r, pa, s, k), n in sorted(stale.items()):
+        print(f"STALE baseline entry {r} {pa} [{s}] {k} x{n} — fixed? "
+              f"prune it", file=err)
+    print(f"dmlp_tpu.check: {len(findings)} finding(s): {len(new)} new, "
+          f"{len(matched)} baselined, {len(stale)} stale baseline "
+          f"entr{'y' if len(stale) == 1 else 'ies'}", file=err)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

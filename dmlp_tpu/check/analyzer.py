@@ -1,0 +1,115 @@
+"""Analysis driver: walk sources, build cross-module facts, run rules.
+
+The collective rule needs package-wide context (declared ``*_AXIS``
+constants, ``obs/comms.py`` model names, axis-helper signatures), so
+analysis is two-phase: parse everything into :class:`ModuleInfo`, then
+run each family over each module. Unparseable files become a synthetic
+``R000`` finding rather than a crash — a syntax error in the tree is a
+finding, not an excuse to skip the gate.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Optional, Sequence
+
+from dmlp_tpu.check.common import ModuleInfo
+from dmlp_tpu.check.findings import Finding
+
+ALL_FAMILIES = ("R0", "R1", "R2", "R3", "R4")
+#: families make check enforces by default; R0 rides in `make lint`
+DEFAULT_FAMILIES = ("R1", "R2", "R3", "R4")
+
+
+def package_root() -> str:
+    """Absolute path of the installed ``dmlp_tpu`` package directory."""
+    import dmlp_tpu
+    return os.path.dirname(os.path.abspath(dmlp_tpu.__file__))
+
+
+def repo_root() -> str:
+    return os.path.dirname(package_root())
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def _relpath(path: str, root: str) -> str:
+    ap = os.path.abspath(path)
+    root = os.path.abspath(root)
+    if ap.startswith(root + os.sep):
+        return os.path.relpath(ap, root).replace(os.sep, "/")
+    return os.path.basename(ap)
+
+
+def load_modules(paths: Sequence[str], root: Optional[str] = None
+                 ) -> tuple:
+    """(modules, parse_findings) for every .py under ``paths``."""
+    root = root or repo_root()
+    modules: List[ModuleInfo] = []
+    findings: List[Finding] = []
+    for path in _iter_py_files(paths):
+        rel = _relpath(path, root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            modules.append(ModuleInfo(path, rel, source))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            findings.append(Finding(
+                "R000", rel, getattr(e, "lineno", 0) or 0, 0, "",
+                "unparseable", f"cannot analyze: {e}"))
+    return modules, findings
+
+
+def analyze_modules(modules: List[ModuleInfo],
+                    families: Optional[Sequence[str]] = None
+                    ) -> List[Finding]:
+    from dmlp_tpu.check.collectives import CollectiveRule
+    from dmlp_tpu.check.compatrule import CompatRule
+    from dmlp_tpu.check.hostsync import HostSyncRule
+    from dmlp_tpu.check.hygiene import HygieneRule
+    from dmlp_tpu.check.recompile import RecompileRule
+
+    fams = set(families or DEFAULT_FAMILIES)
+    findings: List[Finding] = []
+    add = findings.append
+    rules = []
+    if "R0" in fams:
+        rules.append(HygieneRule())
+    if "R1" in fams:
+        rules.append(CollectiveRule(modules))
+    if "R2" in fams:
+        rules.append(RecompileRule())
+    if "R3" in fams:
+        rules.append(HostSyncRule())
+    if "R4" in fams:
+        rules.append(CompatRule())
+    for mod in modules:
+        for rule in rules:
+            rule.run(mod, add)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_paths(paths: Sequence[str],
+                  families: Optional[Sequence[str]] = None,
+                  root: Optional[str] = None) -> List[Finding]:
+    modules, parse_findings = load_modules(paths, root=root)
+    return parse_findings + analyze_modules(modules, families)
+
+
+def analyze_package(families: Optional[Sequence[str]] = None
+                    ) -> List[Finding]:
+    """Analyze the whole installed ``dmlp_tpu`` package."""
+    return analyze_paths([package_root()], families)

@@ -1,0 +1,252 @@
+"""R1 — the collective-axis contract.
+
+Three checks over every ``jax.lax`` collective call site (and every call
+into a package helper with an ``axis_name`` parameter):
+
+- **R101**: the axis argument must resolve to a mesh axis some
+  ``*_AXIS`` constant in the package declares (parallel/mesh.py,
+  train/sharding.py, ...). String typos and undeclared axes are the
+  classic silent-wrong-program bug — psum over a nonexistent axis fails
+  only at trace time, on the mesh, with an opaque error.
+- **R102**: when the call sits lexically inside a function that this
+  module shard_maps, the resolved axis must appear in that shard_map's
+  in/out PartitionSpecs — a collective over an axis the specs never
+  mention is either dead replication or a wrong-mesh bug.
+- **R103/R104**: traffic-bearing collectives in ``engine/``,
+  ``parallel/``, ``train/`` must carry a
+  ``# check: comms-model=<fn>[,<fn>]`` annotation naming their analytic
+  traffic model in ``obs/comms.py`` (or ``# check: no-traffic`` with a
+  reason in prose). This is the static half of the analytic-vs-traced
+  reconcile: a new collective without a model, or a model function that
+  was renamed away, fails ``make check`` instead of silently skewing
+  every comms record.
+
+Axis arguments resolve through: string literals, ``*_AXIS`` constants
+(local or imported), and function parameters — parameter-passed axes
+are checked at each *call site* of the helper instead (depth-limited),
+so ``ring_allreduce_topk(..., DATA_AXIS)`` validates where the axis is
+actually chosen.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from dmlp_tpu.check.common import ModuleInfo, call_name
+from dmlp_tpu.check.findings import Finding
+
+#: collective -> positional index of its axis-name argument
+AXIS_ARG = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "all_gather": 1, "all_to_all": 1, "psum_scatter": 1, "axis_index": 0,
+}
+#: collectives that move bytes (axis_index only reads the coordinate)
+TRAFFIC = {"psum", "pmean", "pmax", "pmin", "ppermute", "all_gather",
+           "all_to_all", "psum_scatter"}
+#: directories whose collectives must map to an obs/comms.py model
+TRAFFIC_SCOPE = ("dmlp_tpu/engine/", "dmlp_tpu/parallel/",
+                 "dmlp_tpu/train/")
+
+_LAX_PREFIXES = ("jax.lax.", "lax.")
+
+
+def collective_kind(call: ast.Call) -> Optional[str]:
+    name = call_name(call)
+    if name is None:
+        return None
+    for pref in _LAX_PREFIXES:
+        if name.startswith(pref) and name[len(pref):] in AXIS_ARG:
+            return name[len(pref):]
+    return None
+
+
+def _axis_arg_expr(call: ast.Call, kind: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            return kw.value
+    idx = AXIS_ARG[kind]
+    if len(call.args) > idx:
+        return call.args[idx]
+    return None
+
+
+def resolve_axis(expr: ast.AST, mod: ModuleInfo,
+                 axis_consts: Dict[str, str]) -> object:
+    """A string axis, a list of them (tuple axes), the marker
+    ``("param", name)`` for function parameters, or None (opaque)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for e in expr.elts:
+            r = resolve_axis(e, mod, axis_consts)
+            if not isinstance(r, str):
+                return None
+            out.append(r)
+        return out
+    if isinstance(expr, ast.Name):
+        if expr.id in mod.str_consts:
+            return mod.str_consts[expr.id]
+        src = mod.imports.get(expr.id, "")
+        leaf = src.rsplit(".", 1)[-1] if src else expr.id
+        if leaf in axis_consts:
+            return axis_consts[leaf]
+        return ("param", expr.id)
+    return None
+
+
+class CollectiveRule:
+    """One instance runs over the whole package (needs cross-module
+    facts: declared axes, obs/comms.py model names, axis-helper
+    signatures)."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = modules
+        self.axis_consts: Dict[str, str] = {}
+        self.declared: Set[str] = set()
+        self.comms_models: Set[str] = set()
+        self.axis_helpers: Dict[str, int] = {}
+        for m in modules:
+            for name, val in m.str_consts.items():
+                if name.endswith("_AXIS"):
+                    self.axis_consts[name] = val
+                    self.declared.add(val)
+            if m.relpath.replace("\\", "/").endswith("obs/comms.py"):
+                for name, node in m.defs.items():
+                    self.comms_models.add(name)
+            for name, node in m.defs.items():
+                args = node.args.posonlyargs + node.args.args
+                for i, a in enumerate(args):
+                    if a.arg == "axis_name":
+                        self.axis_helpers[name] = i
+
+    # -- per-module ----------------------------------------------------------
+    def run(self, mod: ModuleInfo, add) -> None:
+        specs_by_def = self._shard_map_specs(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = collective_kind(node)
+            if kind is not None:
+                self._check_site(mod, node, kind,
+                                 _axis_arg_expr(node, kind),
+                                 specs_by_def, add)
+                continue
+            # calls into package axis helpers: the axis is chosen HERE
+            name = call_name(node)
+            leaf = name.rsplit(".", 1)[-1] if name else None
+            if leaf in self.axis_helpers:
+                idx = self.axis_helpers[leaf]
+                expr = None
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        expr = kw.value
+                if expr is None and len(node.args) > idx:
+                    expr = node.args[idx]
+                if expr is not None:
+                    self._check_axis_value(mod, node, f"{leaf}(axis_name)",
+                                           expr, specs_by_def, add,
+                                           helper=True)
+
+    def _check_site(self, mod: ModuleInfo, node: ast.Call, kind: str,
+                    axis_expr, specs_by_def, add) -> None:
+        if axis_expr is not None:
+            self._check_axis_value(mod, node, kind, axis_expr,
+                                   specs_by_def, add)
+        if kind in TRAFFIC:
+            self._check_traffic(mod, node, kind, add)
+
+    def _check_axis_value(self, mod: ModuleInfo, node: ast.AST, what: str,
+                          expr, specs_by_def, add, helper: bool = False
+                          ) -> None:
+        resolved = resolve_axis(expr, mod, self.axis_consts)
+        if resolved is None or (isinstance(resolved, tuple)
+                                and resolved[0] == "param"):
+            # Parameter-passed axes validate at the helper's call sites
+            # (this function IS that check when ``helper``); opaque
+            # expressions are not guessed at.
+            return
+        axes = resolved if isinstance(resolved, list) else [resolved]
+        if mod.allowed(node, "allow-collective"):
+            return
+        for ax in axes:
+            if ax not in self.declared:
+                add(Finding(
+                    "R101", mod.relpath, node.lineno, node.col_offset,
+                    mod.scope_of(node), f"{what}:{ax}",
+                    f"{what} names mesh axis {ax!r}, which no *_AXIS "
+                    f"constant declares (declared: "
+                    f"{sorted(self.declared)})"))
+                continue
+            spec_axes = self._enclosing_spec_axes(mod, node, specs_by_def)
+            if spec_axes is not None and ax not in spec_axes:
+                add(Finding(
+                    "R102", mod.relpath, node.lineno, node.col_offset,
+                    mod.scope_of(node), f"{what}:{ax}",
+                    f"{what} uses axis {ax!r} but the enclosing "
+                    f"shard_map specs only mention "
+                    f"{sorted(spec_axes)}"))
+
+    def _check_traffic(self, mod: ModuleInfo, node: ast.Call, kind: str,
+                       add) -> None:
+        rel = mod.relpath.replace("\\", "/")
+        if not any(rel.startswith(p) or f"/{p}" in rel
+                   for p in TRAFFIC_SCOPE):
+            return
+        if mod.allowed(node, "no-traffic") \
+                or mod.allowed(node, "allow-collective"):
+            return
+        models: List[str] = []
+        for v in mod.directive_values(node, "comms-model"):
+            models.extend(x for x in v.split(",") if x)
+        if not models:
+            add(Finding(
+                "R103", mod.relpath, node.lineno, node.col_offset,
+                mod.scope_of(node), kind,
+                f"{kind} moves bytes but carries no `# check: "
+                f"comms-model=<fn>` annotation naming its analytic "
+                f"model in obs/comms.py (or `# check: no-traffic`)"))
+            return
+        for m in models:
+            if m not in self.comms_models:
+                add(Finding(
+                    "R104", mod.relpath, node.lineno, node.col_offset,
+                    mod.scope_of(node), f"{kind}:{m}",
+                    f"comms-model annotation names {m!r}, but "
+                    f"obs/comms.py defines no such function"))
+
+    # -- shard_map spec plumbing --------------------------------------------
+    def _shard_map_specs(self, mod: ModuleInfo) -> Dict[str, Set[str]]:
+        """def name -> set of axis names its shard_map specs mention."""
+        out: Dict[str, Set[str]] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None or name.split(".")[-1] != "shard_map":
+                continue
+            target = None
+            if node.args and isinstance(node.args[0], ast.Name):
+                target = node.args[0].id
+            axes: Set[str] = set()
+            for kw in node.keywords:
+                if kw.arg in ("in_specs", "out_specs"):
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Constant) \
+                                and isinstance(sub.value, str):
+                            axes.add(sub.value)
+                        elif isinstance(sub, ast.Name):
+                            r = resolve_axis(sub, mod, self.axis_consts)
+                            if isinstance(r, str):
+                                axes.add(r)
+            if target and axes:
+                out[target] = out.get(target, set()) | axes
+        return out
+
+    def _enclosing_spec_axes(self, mod: ModuleInfo, node: ast.AST,
+                             specs_by_def) -> Optional[Set[str]]:
+        for fn in mod.enclosing_funcs(node):
+            if fn.name in specs_by_def:
+                return specs_by_def[fn.name]
+        return None

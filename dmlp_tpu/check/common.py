@@ -1,0 +1,276 @@
+"""Shared AST machinery for the rule modules.
+
+One :class:`ModuleInfo` per source file carries everything every rule
+family needs — the parsed tree, parent links, ``# check:`` directives by
+line, import/constant tables, and the set of function defs that are
+*traced* (jit-decorated, or passed into ``jax.jit``/``shard_map``) — so
+each rule module stays a thin visitor over pre-digested facts.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+CHECK_COMMENT = "# check:"
+
+
+def parse_directives(source: str) -> Dict[int, Set[str]]:
+    """``# check: <d1> <d2>`` comments by 1-based line.
+
+    A directive silences findings on its own line; a *standalone*
+    comment line (nothing but the comment) also covers the next
+    non-comment line, so multi-line calls can carry their annotation
+    above the statement.
+    """
+    out: Dict[int, Set[str]] = {}
+    carry: Set[str] = set()
+    for i, raw in enumerate(source.splitlines(), start=1):
+        line = raw.strip()
+        ds: Set[str] = set()
+        pos = raw.find(CHECK_COMMENT)
+        # Only real comments count: a '# check:' inside a string literal
+        # has code (an opening quote) before the '#' on the line — the
+        # cheap test below is "comment starts the stripped line or is
+        # preceded by code"; string false-positives only ADD allow
+        # directives, never hide real code, so the cheap test is enough.
+        if pos >= 0:
+            ds = set(raw[pos + len(CHECK_COMMENT):].split())
+        if line.startswith("#"):
+            carry |= ds
+            continue
+        if ds or carry:
+            out[i] = ds | carry
+        carry = set()
+    return out
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``jax.lax.psum`` for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def is_docstring(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> bool:
+    """Is this Constant-str the docstring expression of its scope?"""
+    p = parents.get(node)
+    if not (isinstance(p, ast.Expr) and isinstance(node, ast.Constant)
+            and isinstance(node.value, str)):
+        return False
+    gp = parents.get(p)
+    return isinstance(gp, (ast.Module, ast.FunctionDef,
+                           ast.AsyncFunctionDef, ast.ClassDef)) \
+        and gp.body and gp.body[0] is p
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """How a def is traced: 'jit' (decorated / wrapped in jax.jit) or
+    'shard_map' (passed to the compat/jax shard_map), plus the
+    static_argnames its jit wrapper pins (empty for shard_map)."""
+
+    kind: str
+    static_argnames: Set[str] = dataclasses.field(default_factory=set)
+
+
+class ModuleInfo:
+    """Parsed + pre-digested facts about one source file."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.directives = parse_directives(source)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.scopes: Dict[ast.AST, str] = {}
+        self._link(self.tree, None, [])
+        # import name -> dotted module/source ("np" -> "numpy",
+        # "shard_map" -> "dmlp_tpu.utils.compat.shard_map")
+        self.imports: Dict[str, str] = {}
+        # module-level NAME = "literal" string constants
+        self.str_consts: Dict[str, str] = {}
+        # module-level names bound to mutable literals ([], {}, set())
+        self.mutable_globals: Set[str] = set()
+        # name -> wrapped function name for f = functools.partial(g, ...)
+        self.partial_aliases: Dict[str, str] = {}
+        self._scan_module_level()
+        # def name -> JitInfo for traced defs (jit/shard_map)
+        self.traced: Dict[str, JitInfo] = {}
+        self.defs: Dict[str, ast.AST] = {}
+        self._scan_traced()
+
+    # -- structure ----------------------------------------------------------
+    def _link(self, node: ast.AST, parent, scope: List[str]):
+        if parent is not None:
+            self.parents[node] = parent
+        self.scopes[node] = ".".join(scope)
+        push = isinstance(node, _FUNC_NODES + (ast.ClassDef,))
+        for child in ast.iter_child_nodes(node):
+            self._link(child, node,
+                       scope + [node.name] if push else scope)
+
+    def scope_of(self, node: ast.AST) -> str:
+        return self.scopes.get(node, "")
+
+    def enclosing_funcs(self, node: ast.AST) -> List[ast.AST]:
+        """Innermost-first chain of enclosing function defs."""
+        out = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, _FUNC_NODES):
+                out.append(cur)
+            cur = self.parents.get(cur)
+        return out
+
+    def _directive_lines(self, node: ast.AST) -> set:
+        """Lines whose directives govern ``node``: the node's own span
+        and its statement's first line. Line-above annotations are
+        handled by parse_directives' standalone-comment carry (the
+        directive lands ON the next code line) — consulting
+        ``lineno - 1`` directly would let a TRAILING directive on one
+        statement silently cover the next one too."""
+        lines = {getattr(node, "lineno", 0),
+                 getattr(node, "end_lineno", 0) or 0}
+        stmt = self.parents.get(node)
+        while stmt is not None and not isinstance(stmt, ast.stmt):
+            stmt = self.parents.get(stmt)
+        if stmt is not None:
+            lines.add(stmt.lineno)
+        return lines
+
+    def allowed(self, node: ast.AST, directive: str) -> bool:
+        return any(directive in self.directives.get(ln, ())
+                   for ln in self._directive_lines(node))
+
+    def directive_values(self, node: ast.AST, prefix: str) -> List[str]:
+        """Values of ``<prefix>=<value>`` directives governing ``node``."""
+        lines = self._directive_lines(node)
+        vals = []
+        for ln in sorted(lines):
+            for d in self.directives.get(ln, ()):
+                if d.startswith(prefix + "="):
+                    vals.append(d[len(prefix) + 1:])
+        return vals
+
+    # -- module-level tables -------------------------------------------------
+    def _scan_module_level(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    self.imports[a.asname or a.name] = f"{mod}.{a.name}"
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                v = stmt.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    self.str_consts[name] = v.value
+                if isinstance(v, (ast.List, ast.Dict, ast.Set)) or (
+                        isinstance(v, ast.Call)
+                        and call_name(v) in ("list", "dict", "set")):
+                    self.mutable_globals.add(name)
+
+    # -- traced-def discovery ------------------------------------------------
+    def _is_jit_expr(self, node: ast.AST) -> bool:
+        """Does this expression denote jax.jit (or a partial of it)?"""
+        name = dotted(node)
+        if name in ("jax.jit", "jit"):
+            return True
+        if isinstance(node, ast.Call) \
+                and call_name(node) in ("functools.partial", "partial"):
+            return node.args and self._is_jit_expr(node.args[0])
+        return False
+
+    def jit_static_argnames(self, node: ast.AST) -> Set[str]:
+        """static_argnames from a partial(jax.jit, ...) / jax.jit(...)."""
+        out: Set[str] = set()
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg in ("static_argnames", "static_argnums"):
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Constant) \
+                                and isinstance(sub.value, str):
+                            out.add(sub.value)
+        return out
+
+    def _mark(self, name: str, info: JitInfo):
+        name = self.partial_aliases.get(name, name)
+        prev = self.traced.get(name)
+        if prev is None or (prev.kind != "jit" and info.kind == "jit"):
+            self.traced[name] = info
+
+    def _scan_traced(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, _FUNC_NODES):
+                self.defs.setdefault(node.name, node)
+                for dec in node.decorator_list:
+                    if self._is_jit_expr(dec):
+                        self._mark(node.name, JitInfo(
+                            "jit", self.jit_static_argnames(dec)))
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and call_name(node.value) in ("functools.partial",
+                                                  "partial") \
+                    and node.value.args \
+                    and isinstance(node.value.args[0], ast.Name) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                self.partial_aliases[node.targets[0].id] = \
+                    node.value.args[0].id
+        # second pass: functions fed to jax.jit(...) / shard_map(...)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in ("jax.jit", "jit") and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                self._mark(node.args[0].id, JitInfo(
+                    "jit", self.jit_static_argnames(node)))
+            if name is not None and name.split(".")[-1] == "shard_map":
+                target = None
+                if node.args and isinstance(node.args[0], ast.Name):
+                    target = node.args[0].id
+                elif node.args and isinstance(node.args[0], ast.Call):
+                    inner = node.args[0]
+                    if call_name(inner) in ("functools.partial", "partial") \
+                            and inner.args \
+                            and isinstance(inner.args[0], ast.Name):
+                        target = inner.args[0].id
+                if target:
+                    self._mark(target, JitInfo("shard_map"))
+
+    def traced_def_nodes(self) -> List[Tuple[ast.AST, JitInfo]]:
+        """(def node, JitInfo) for every traced def, including defs
+        lexically nested inside a traced def (their bodies trace too)."""
+        out = []
+        roots = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, _FUNC_NODES) and node.name in self.traced:
+                roots.append((node, self.traced[node.name]))
+        seen = set()
+        for root, info in roots:
+            for sub in ast.walk(root):
+                if isinstance(sub, _FUNC_NODES) and id(sub) not in seen:
+                    seen.add(id(sub))
+                    out.append((sub, info if sub is root
+                                else JitInfo(info.kind)))
+        return out

@@ -1,0 +1,89 @@
+"""R4 — compat-bypass: drifting jax APIs used outside ``utils/compat.py``.
+
+PR 2's ``utils/compat.py`` pins every jax API this tree has already
+been burned by (shard_map spelling + ``check_vma``/``check_rep``,
+``axis_size``, Pallas ``CompilerParams`` rename, host memory kinds).
+The shims only work if they stay the single point of drift — one direct
+``jax.experimental.shard_map`` import in a new engine quietly re-breaks
+the floor jax version. This family makes the funnel mandatory:
+
+- **R401** any shard_map spelling that is not
+  ``utils.compat.shard_map``;
+- **R402** ``jax.lax.axis_size`` (compat maps it to the ``psum(1,...)``
+  constant on older jax);
+- **R403** ``CompilerParams``/``TPUCompilerParams`` attributes (compat
+  handles the rename and drops unknown kwargs);
+- **R404** hard-coded ``"pinned_host"``/``"unpinned_host"`` memory-kind
+  strings (compat resolves what this backend actually exposes).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dmlp_tpu.check.common import ModuleInfo, dotted, is_docstring
+from dmlp_tpu.check.findings import Finding
+
+ALLOW = "allow-compat"
+_EXEMPT_SUFFIX = "utils/compat.py"
+
+
+def exempt(relpath: str) -> bool:
+    rel = relpath.replace("\\", "/")
+    # compat.py is the one legitimate user; the checker itself names the
+    # patterns it hunts (this file's own strings must not self-flag).
+    return rel.endswith(_EXEMPT_SUFFIX) or "dmlp_tpu/check/" in rel \
+        or rel.startswith("dmlp_tpu/check/")
+
+
+class CompatRule:
+    def run(self, mod: ModuleInfo, add) -> None:
+        if exempt(mod.relpath):
+            return
+
+        def flag(rule, node, key, msg):
+            if not mod.allowed(node, ALLOW):
+                add(Finding(rule, mod.relpath, node.lineno,
+                            node.col_offset, mod.scope_of(node), key,
+                            msg))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                m = node.module or ""
+                if m.startswith("jax.experimental.shard_map"):
+                    flag("R401", node, "import-shard_map",
+                         "import from jax.experimental.shard_map — "
+                         "use dmlp_tpu.utils.compat.shard_map")
+                if m == "jax.lax" and any(a.name == "axis_size"
+                                          for a in node.names):
+                    flag("R402", node, "import-axis_size",
+                         "import of jax.lax.axis_size — use "
+                         "dmlp_tpu.utils.compat.axis_size")
+                if m == "jax" and any(a.name == "shard_map"
+                                      for a in node.names):
+                    flag("R401", node, "import-shard_map",
+                         "import of jax.shard_map — use "
+                         "dmlp_tpu.utils.compat.shard_map")
+            elif isinstance(node, ast.Attribute):
+                name = dotted(node)
+                if name in ("jax.shard_map",
+                            "jax.experimental.shard_map.shard_map"):
+                    flag("R401", node, "attr-shard_map",
+                         f"direct {name} — use "
+                         "dmlp_tpu.utils.compat.shard_map")
+                elif name == "jax.lax.axis_size":
+                    flag("R402", node, "attr-axis_size",
+                         "direct jax.lax.axis_size — use "
+                         "dmlp_tpu.utils.compat.axis_size")
+                elif node.attr in ("CompilerParams", "TPUCompilerParams"):
+                    flag("R403", node, f"attr-{node.attr}",
+                         f"direct Pallas {node.attr} — use "
+                         "dmlp_tpu.utils.compat.tpu_compiler_params "
+                         "(handles the rename + unknown kwargs)")
+            elif isinstance(node, ast.Constant) \
+                    and node.value in ("pinned_host", "unpinned_host") \
+                    and not is_docstring(node, mod.parents):
+                flag("R404", node, f"memkind-{node.value}",
+                     f"hard-coded memory kind {node.value!r} — use "
+                     "dmlp_tpu.utils.compat.host_memory_kind() "
+                     "(older XLA:CPU only exposes unpinned_host)")

@@ -1,0 +1,98 @@
+"""Finding records and their baseline fingerprints.
+
+A finding's *fingerprint* deliberately excludes line/column numbers:
+baselined findings must survive unrelated edits above them in the file,
+so the stable identity is (rule, path, enclosing scope, detail key) —
+the same convention ruff/mypy baselines use. Two identical violations in
+the same scope share a fingerprint; the baseline stores multiplicity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+#: rule id -> one-line description, the ``--list-rules`` catalog.
+RULES = {
+    # R0 — generic hygiene (the conservative ruff subset; make lint)
+    "R001": "unused import (ruff F401)",
+    "R002": "bare `except:` swallows everything (ruff E722)",
+    "R003": "mutable default argument (ruff B006)",
+    "R004": "f-string without placeholders (ruff F541)",
+    # R1 — collective-axis contract
+    "R101": "collective names a mesh axis no *_AXIS constant declares",
+    "R102": "collective axis absent from the enclosing shard_map specs",
+    "R103": "collective call site has no analytic comms-model annotation",
+    "R104": "comms-model annotation names a function obs/comms.py lacks",
+    # R2 — recompilation hazards
+    "R201": "non-hashable default argument on a jit-compiled function",
+    "R202": "f-string construction inside a traced (jit/shard_map) body",
+    "R203": "variant/config resolution inside a traced body (stale-cache"
+            " reuse: the resolved value must be part of the jit key)",
+    "R204": "keyword-only param of a jitted function missing from"
+            " static_argnames",
+    "R205": "traced body closes over a module-level mutable",
+    # R3 — host-sync hazards (engine/, ops/, parallel/ hot paths)
+    "R301": ".item() forces a device sync",
+    "R302": "jax.device_get readback (annotate fenced sites with"
+            " `# check: allow-host-sync`)",
+    "R303": "float()/int()/bool() on a device-producing expression",
+    "R304": "np.asarray/np.array on a device-producing expression"
+            " (implicit transfer; use jax.device_get)",
+    "R305": "branching on a traced value inside a jit body",
+    # R4 — compat-bypass (everywhere but utils/compat.py)
+    "R401": "direct shard_map spelling (use utils.compat.shard_map)",
+    "R402": "direct jax.lax.axis_size (use utils.compat.axis_size)",
+    "R403": "direct Pallas CompilerParams (use"
+            " utils.compat.tpu_compiler_params)",
+    "R404": "hard-coded host memory-kind string (use"
+            " utils.compat.host_memory_kind)",
+}
+
+#: rule id -> allowlist directive that silences it at a call site.
+ALLOW_DIRECTIVES = {
+    "R0": "allow-hygiene",
+    "R1": "allow-collective",
+    "R2": "allow-recompile",
+    "R3": "allow-host-sync",
+    "R4": "allow-compat",
+}
+
+
+def family(rule: str) -> str:
+    """"R103" -> "R1"."""
+    return rule[:2]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site.
+
+    ``key`` is the stable detail used for fingerprinting (no line
+    numbers — see module docstring); ``message`` is the human line.
+    """
+
+    rule: str
+    path: str       # repo-relative, '/'-separated
+    line: int
+    col: int
+    scope: str      # dotted enclosing def/class qualname ('' = module)
+    key: str
+    message: str
+
+    @property
+    def family(self) -> str:
+        return family(self.rule)
+
+    def fingerprint(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.scope, self.key)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["family"] = self.family
+        return d
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col}"
+        scope = f" [{self.scope}]" if self.scope else ""
+        return f"{where}: {self.rule}{scope}: {self.message}"

@@ -1,0 +1,70 @@
+"""Runtime sanitizer: the dynamic half of the host-sync contract.
+
+``DMLP_TPU_SANITIZE=1`` (or ``--sanitize`` on the CLIs) wraps solves in
+
+- ``jax.transfer_guard("disallow")`` — implicit transfers raise.
+  Explicit ``jax.device_put``/``jax.device_get`` stay allowed, which is
+  exactly the R3 (hostsync) discipline: every intentional staging /
+  readback in the engines is explicit, every implicit ``float()`` /
+  ``.item()`` / array conversion of a device value is a bug. What the
+  static pass wants annotated is what the guard rejects un-annotated.
+- ``jax.checking_leaks()`` — tracer leaks out of jitted scopes raise.
+- ``jax.debug_nans`` (train only) — NaN-producing steps raise at the
+  op, not 200 steps later in the loss curve.
+
+Backend note: on this container's CPU backend the guard catches scalar
+conversions (``float``/``.item``) but zero-copy ``np.asarray`` views
+pass; on TPU every implicit device->host readback is a real transfer
+and raises. The engines therefore route ALL intentional readbacks
+through explicit ``jax.device_get`` so a sanitized solve behaves
+identically on both.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Mapping, Optional
+
+ENV_VAR = "DMLP_TPU_SANITIZE"
+_TRUTHY = ("1", "true", "on", "yes")
+
+
+def sanitize_enabled(environ: Optional[Mapping[str, str]] = None) -> bool:
+    env = os.environ if environ is None else environ
+    return str(env.get(ENV_VAR, "")).strip().lower() in _TRUTHY
+
+
+@contextlib.contextmanager
+def sanitized(train: bool = False):
+    """Context under which implicit transfers and tracer leaks raise
+    (plus NaN checks when ``train``). Output of a clean program is
+    byte-identical — the guards only turn silent hazards into errors.
+
+    Solve mode guards ALL directions (``jax.transfer_guard("disallow")``
+    — the engines' chunk pipelines must be explicit end to end).
+    Train mode guards host<->device only: the jitted step re-places
+    state leaves across shardings (e.g. the scalar step counter on
+    first dispatch), and those device->device moves are GSPMD's
+    legitimate job, not host-sync leaks."""
+    import jax
+    with contextlib.ExitStack() as stack:
+        if train:
+            stack.enter_context(
+                jax.transfer_guard_host_to_device("disallow"))
+            stack.enter_context(
+                jax.transfer_guard_device_to_host("disallow"))
+            stack.enter_context(jax.debug_nans(True))
+        else:
+            stack.enter_context(jax.transfer_guard("disallow"))
+        stack.enter_context(jax.checking_leaks())
+        yield
+
+
+def maybe_sanitized(train: bool = False, force: bool = False,
+                    environ: Optional[Mapping[str, str]] = None):
+    """``sanitized()`` when ``force`` or $DMLP_TPU_SANITIZE is truthy,
+    else a null context — the one-liner the CLIs wrap their solve in."""
+    if force or sanitize_enabled(environ):
+        return sanitized(train=train)
+    return contextlib.nullcontext()

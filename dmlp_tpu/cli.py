@@ -185,6 +185,15 @@ def main(argv: Optional[Sequence[str]] = None,
                         help="run the solve once untimed first, so the "
                              "timed region excludes XLA compilation (the "
                              "reference engine pays no JIT)")
+    parser.add_argument("--sanitize", action="store_true",
+                        help="wrap the solve in "
+                             "jax.transfer_guard('disallow') + "
+                             "jax.checking_leaks (dmlp_tpu.check."
+                             "sanitize): implicit host syncs and tracer "
+                             "leaks raise instead of silently "
+                             "serializing; $DMLP_TPU_SANITIZE=1 "
+                             "enables it too. Output is byte-identical "
+                             "on a clean program.")
     args = parser.parse_args(argv)
 
     stdin = stdin or sys.stdin
@@ -224,6 +233,7 @@ def _run_cli(parser, args, stdin, stdout, stderr, tracer, probe) -> int:
 
     # Only the solve is timed, matching the reference's timed region
     # (common.cpp:122-131 brackets Engine::KNN after ingest).
+    from dmlp_tpu.check.sanitize import maybe_sanitized
     engine = None
     if args.engine == "golden":
         timer.start()
@@ -235,7 +245,8 @@ def _run_cli(parser, args, stdin, stdout, stderr, tracer, probe) -> int:
         solve = engine.run_device_full if args.device_full else engine.run
         if args.warmup:
             with timer.phase("warmup_compile"), \
-                    obs_span("cli.warmup_compile"):
+                    obs_span("cli.warmup_compile"), \
+                    maybe_sanitized(force=args.sanitize):
                 solve(inp)
             if probe is not None:
                 # The warmup solve recorded the same dispatches the timed
@@ -250,7 +261,8 @@ def _run_cli(parser, args, stdin, stdout, stderr, tracer, probe) -> int:
             profile_cm = jax.profiler.trace(args.profile)
         timer.start()
         with profile_cm, obs_span("cli.solve", mode=args.mode,
-                                  engine="jax"):
+                                  engine="jax"), \
+                maybe_sanitized(force=args.sanitize):
             results = solve(inp)
     with obs_span("cli.format_results"):
         text = format_results(results, debug=config.debug)

@@ -559,9 +559,9 @@ class ShardedEngine:
             self._last_select = select  # run() gates the tie-overflow repair
             top = self._solve_merged(k, data_block, select, d_attrs,
                                      d_labels, d_ids, q_attrs)
-        out_np = (np.asarray(top.dists, np.float64)[:nq],
-                  np.asarray(top.labels)[:nq],
-                  np.asarray(top.ids)[:nq])
+        # check: allow-host-sync
+        od, ol, oi = jax.device_get((top.dists, top.labels, top.ids))
+        out_np = (np.asarray(od, np.float64)[:nq], ol[:nq], oi[:nq])
         flush_measured_iters(self)  # post-fetch: a scalar readback
         return out_np
 
@@ -753,9 +753,12 @@ class ShardedEngine:
             # just readback bytes.
             t0 = _time.perf_counter()
             with obs_span("sharded.fetch", select=select):
-                dists = np.asarray(top.dists, np.float64)[:nq]
-                labels = np.asarray(top.labels)[:nq]
-                ids = np.asarray(top.ids)[:nq]
+                # check: allow-host-sync
+                od, ol, oi = jax.device_get((top.dists, top.labels,
+                                             top.ids))
+                dists = np.asarray(od, np.float64)[:nq]
+                labels = ol[:nq]
+                ids = oi[:nq]
             fetch_ms += (_time.perf_counter() - t0) * 1e3
             t0 = _time.perf_counter()
             with obs_span("sharded.finalize", exact=self.config.exact):
@@ -873,10 +876,12 @@ class ShardedEngine:
                 # Plain jit: inputs arrive query-sharded and XLA
                 # partitions the (Q, K)-local vote/report accordingly.
                 p, i, d = _device_epilogue(
-                    top, jax.device_put(jnp.asarray(ks_pad), ksh),
+                    top, jax.device_put(ks_pad, ksh),
                     num_labels=num_labels)
-                preds = np.asarray(p)[:nqs]
-                rids = np.asarray(i)[:nqs]
+                # check: allow-host-sync
+                p, i, d = jax.device_get((p, i, d))
+                preds = p[:nqs]
+                rids = i[:nqs]
                 rd = np.asarray(d, np.float64)[:nqs]
                 gids = np.arange(nqs) if idx is None else idx
                 for qi in range(nqs):
@@ -895,7 +900,7 @@ class ShardedEngine:
 
         ks_pad = np.zeros(qpad, np.int32)
         ks_pad[:nq] = inp.ks
-        ks_dev = jax.device_put(jnp.asarray(ks_pad), ksh)
+        ks_dev = jax.device_put(ks_pad, ksh)
 
         fn_full = self._fn_full(k, data_block, select, num_labels)
         full_args = (d_attrs, d_labels, d_ids, q_attrs, ks_dev)
@@ -911,8 +916,10 @@ class ShardedEngine:
         self._queue_iters("sharded.device_full", select, its,
                           qpad // c, d_attrs.shape[0] // r,
                           d_attrs.shape[1], k)
-        preds = np.asarray(p)[:nq]
-        rids = np.asarray(i)[:nq]
+        # check: allow-host-sync
+        p, i, d = jax.device_get((p, i, d))
+        preds = p[:nq]
+        rids = i[:nq]
         rd = np.asarray(d, np.float64)[:nq]
         results = [QueryResult(qi, int(inp.ks[qi]), int(preds[qi]),
                                rids[qi, : int(inp.ks[qi])].astype(np.int64),

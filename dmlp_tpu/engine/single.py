@@ -73,6 +73,27 @@ def round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def np_staging_dtype(staging: str):
+    """Host wire dtype for a staging mode ("float32" | "bfloat16").
+
+    The engines convert on HOST and stage with explicit
+    ``jax.device_put``: the sanitizer's transfer guard
+    (``--sanitize`` / dmlp_tpu.check.sanitize) disallows *implicit*
+    transfers, and staging is the one transfer that is the engines'
+    explicit job — ``jnp.asarray`` staging would trip the guard on TPU.
+    """
+    if staging == "bfloat16":
+        import ml_dtypes
+        return ml_dtypes.bfloat16
+    return np.float32
+
+
+def stage_put(arr: np.ndarray, staging: str = "float32"):
+    """Explicit (async) host->device put in the staging wire dtype —
+    the transfer-guard-proof spelling of ``jnp.asarray(arr, dtype)``."""
+    return jax.device_put(np.asarray(arr, np_staging_dtype(staging)))
+
+
 def plan_chunks(n: int, granule: int, target: int | None) -> Tuple[int, int, int]:
     """Chunked-staging plan shared by the pipelined and extract drivers:
     (npad, nchunks, chunk_rows) — ~``target``-row chunks (default 51200,
@@ -234,7 +255,7 @@ def flush_measured_iters(engine) -> None:
         return
     for site, s, shape in pend:
         try:
-            obs_counters.record_measured_iters(
+            obs_counters.record_measured_iters(  # check: allow-host-sync
                 site, int(jax.device_get(s)), shape)
         except Exception:
             pass  # observability must never fail the solve
@@ -433,10 +454,10 @@ class SingleChipEngine:
         kmax = int(inp.ks.max()) if inp.params.num_queries else 1
         k = resolve_kcap(cfg, kmax, select, attrs.shape[0],
                          staging=self._staging)
-        d_attrs = jnp.asarray(attrs, self._dtype)
+        d_attrs = stage_put(attrs, self._staging)
         self._last_select = select  # run() gates the tie-overflow repair on it
-        return (d_attrs, jnp.asarray(labels), jnp.asarray(ids), k, data_block,
-                select)
+        return (d_attrs, jax.device_put(labels), jax.device_put(ids), k,
+                data_block, select)
 
     def _solve_scan(self, inp: KNNInput) -> Tuple[TopK, int]:
         """Whole-dataset staging + one lax.map/scan dispatch ("sort" path)."""
@@ -447,8 +468,8 @@ class SingleChipEngine:
         qpad = round_up(max(nq, 1), qb)
         q_attrs = np.zeros((qpad, inp.params.num_attrs), np.float32)
         q_attrs[:nq] = inp.query_attrs
-        q_blocks = jnp.asarray(
-            q_attrs.reshape(qpad // qb, qb, -1), self._dtype)
+        q_blocks = stage_put(
+            q_attrs.reshape(qpad // qb, qb, -1), self._staging)
 
         statics = dict(k=k, data_block=data_block, select=select,
                        use_pallas=cfg.use_pallas)
@@ -506,7 +527,7 @@ class SingleChipEngine:
 
         q_attrs = np.zeros((qpad, na), np.float32)
         q_attrs[:nq] = inp.query_attrs
-        q_dev = [jnp.asarray(q_attrs[i * qsb:(i + 1) * qsb], self._dtype)
+        q_dev = [stage_put(q_attrs[i * qsb:(i + 1) * qsb], self._staging)
                  for i in range(nqb)]
 
         # Stage chunks (async puts) and enqueue their folds immediately,
@@ -526,8 +547,8 @@ class SingleChipEngine:
                     a[:hi - lo] = src_attrs[lo:hi]
                     lab[:hi - lo] = inp.labels[lo:hi]
                     ids[:hi - lo] = np.arange(lo, hi, dtype=np.int32)
-                da = jnp.asarray(a, self._dtype)
-                dl, di = jnp.asarray(lab), jnp.asarray(ids)
+                da = stage_put(a, self._staging)
+                dl, di = jax.device_put(lab), jax.device_put(ids)
                 if c == 0:
                     obs_counters.record_dispatch(
                         _chunk_fold, (carries[0], q_dev[0], da, dl, di),
@@ -585,7 +606,7 @@ class SingleChipEngine:
 
         q_attrs = np.zeros((qpad, na), np.float32)
         q_attrs[:nq] = inp.query_attrs
-        q_dev = jnp.asarray(q_attrs, self._dtype)
+        q_dev = stage_put(q_attrs, self._staging)
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
         od = oi = None
         mi = MeasuredIters(self, "single.extract_topk",
@@ -601,7 +622,7 @@ class SingleChipEngine:
                 a = np.zeros((chunk_rows, na), np.float32)
                 if hi > lo:
                     a[:hi - lo] = src_attrs[lo:hi]
-                da = jnp.asarray(a, self._dtype)
+                da = stage_put(a, self._staging)
                 if c == 0:
                     # Resolved via the analytic kernel model
                     # (obs.kernel_cost) — pallas_call has no XLA cost.
@@ -617,7 +638,7 @@ class SingleChipEngine:
         mi.done()
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
-        top = _extract_finalize(od, oi, jnp.asarray(inp.labels), k=k)
+        top = _extract_finalize(od, oi, jax.device_put(inp.labels), k=k)
         return top, qpad
 
     # Multi-pass resident-dataset budget: every pass re-sweeps the staged
@@ -715,7 +736,7 @@ class SingleChipEngine:
         t0 = _time.perf_counter()
         q_attrs = np.zeros((qpad, na), np.float32)
         q_attrs[:nq] = inp.query_attrs
-        q_dev = jnp.asarray(q_attrs, self._dtype)
+        q_dev = stage_put(q_attrs, self._staging)
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
 
         # Pass 1 overlaps with staging, like the single-pass driver; the
@@ -731,7 +752,7 @@ class SingleChipEngine:
                 break
             a = np.zeros((chunk_rows, na), np.float32)
             a[:hi - lo] = src_attrs[lo:hi]
-            da = jnp.asarray(a, self._dtype)
+            da = stage_put(a, self._staging)
             if c == 0:
                 obs_counters.record_dispatch(
                     extract_topk, (q_dev, da), statics=dict(kc=kc),
@@ -756,7 +777,11 @@ class SingleChipEngine:
                                  inp.query_attrs)
         dn_max = float(np.einsum("na,na->n", inp.data_attrs,
                                  inp.data_attrs).max())
-        qn_dev = jnp.asarray(qn_host, jnp.float32)
+        qn_dev = jax.device_put(np.asarray(qn_host, np.float32))
+        # Explicit device scalar: dn_max rides _mp_floor as a traced
+        # arg, and the sanitizer's transfer guard disallows the implicit
+        # python-float -> device conversion at the jit boundary.
+        dn_dev = jax.device_put(np.float32(dn_max))
         # Passes 2..P sweep the RESIDENT dataset: one whole-array kernel
         # dispatch per pass (the kernel grids over blocks internally)
         # instead of nchunks dispatches — chunking only existed to
@@ -776,7 +801,7 @@ class SingleChipEngine:
         mir = MeasuredIters(self, "single.extract_mp_resident",
                             (qpad, full_rows, na, kc))
         for _p in range(1, npasses):
-            floor_dev, fd = _mp_floor(ods[-1], qn_dev, dn_max,
+            floor_dev, fd = _mp_floor(ods[-1], qn_dev, dn_dev,
                                       staging=self._staging, na=na)
             fds.append(fd)
             od, oi, _iters = extract_topk(q_dev, d_full, n_real=n, id_base=0,
@@ -790,7 +815,7 @@ class SingleChipEngine:
         # Final pass's fd too: a plateau pinning the LAST boundary must
         # flag as well (its ties are the one loss the outer boundary test
         # can miss when kcap >= n).
-        fds.append(_mp_floor(ods[-1], qn_dev, dn_max,
+        fds.append(_mp_floor(ods[-1], qn_dev, dn_dev,
                              staging=self._staging, na=na)[1])
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
         self.last_mp_passes = len(ods)
@@ -800,10 +825,10 @@ class SingleChipEngine:
                           kcap=kcap, chunks=n_staged)
         top, valid = _mp_merge(jnp.concatenate(ods, axis=1),
                                jnp.concatenate(ois, axis=1),
-                               jnp.asarray(inp.labels), kcap=kcap)
+                               jax.device_put(inp.labels), kcap=kcap)
         # One fence for everything: fd sequence (stall check), final
         # valid counts (shortfall check).
-        fetched = jax.device_get([valid] + fds)
+        fetched = jax.device_get([valid] + fds)  # check: allow-host-sync
         valid_h, fd_h = fetched[0], fetched[1:]
         stalled = np.zeros(qpad, bool)
         for prev, cur in zip(fd_h, fd_h[1:]):
@@ -875,14 +900,14 @@ class SingleChipEngine:
 
         qb_host = np.zeros((qpad_b, na), np.float32)
         qb_host[:len(bulk)] = inp.query_attrs[bulk]
-        qb_dev = jnp.asarray(qb_host, self._dtype)
+        qb_dev = stage_put(qb_host, self._staging)
         qo_pad = round_up(len(outl), 8)
         qo_host = np.zeros((qo_pad, na), np.float32)
         qo_host[:len(outl)] = inp.query_attrs[outl]
-        qo_dev = jnp.asarray(qo_host, self._dtype)
+        qo_dev = stage_put(qo_host, self._staging)
         labels_pad = np.full(nchunks * chunk_rows, -1, np.int32)
         labels_pad[:n] = inp.labels
-        labels_dev = jnp.asarray(labels_pad)
+        labels_dev = jax.device_put(labels_pad)
 
         carry_o = init_topk(qo_pad, ko)
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
@@ -897,7 +922,7 @@ class SingleChipEngine:
             a = np.zeros((chunk_rows, na), np.float32)
             if hi > lo:
                 a[:hi - lo] = src_attrs[lo:hi]
-            da = jnp.asarray(a, self._dtype)
+            da = stage_put(a, self._staging)
             if c == 0:
                 obs_counters.record_dispatch(
                     extract_topk, (qb_dev, da), statics=dict(kc=kb),
@@ -908,14 +933,16 @@ class SingleChipEngine:
                 interpret=interpret)
             mi.add(_iters)
             carry_o = _outlier_fold(
-                carry_o, qo_dev, da, labels_dev, jnp.int32(lo),
-                jnp.int32(n), chunk_rows=chunk_rows, k=ko,
+                carry_o, qo_dev, da, labels_dev,
+                jax.device_put(np.int32(lo)), jax.device_put(np.int32(n)),
+                chunk_rows=chunk_rows, k=ko,
                 select=select_out, use_pallas=cfg.use_pallas)
             throttle.tick(carry_o.dists)
         mi.done()
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
-        top_b = _extract_finalize(od, oi, jnp.asarray(inp.labels), k=kb)
+        top_b = _extract_finalize(od, oi, jax.device_put(inp.labels),
+                                  k=kb)
         return [(top_b, qpad_b, bulk, "extract"),
                 (carry_o, qo_pad, outl, select_out)]
 
@@ -954,9 +981,13 @@ class SingleChipEngine:
         with staging_for_k(self, kmax):
             out, qpad = self._solve(inp)
         nq = inp.params.num_queries
-        dists = np.asarray(out.dists, np.float64)[:nq]
-        labels = np.asarray(out.labels)[:nq]
-        ids = np.asarray(out.ids)[:nq]
+        # Explicit fenced readback (the result fetch IS the fence); the
+        # sanitizer's transfer guard allows device_get, never implicit
+        # conversion.  # check: allow-host-sync
+        od, ol, oi = jax.device_get((out.dists, out.labels, out.ids))
+        dists = np.asarray(od, np.float64)[:nq]
+        labels = ol[:nq]
+        ids = oi[:nq]
         self._flush_measured_iters()
         return dists, labels, ids
 
@@ -1000,7 +1031,7 @@ class SingleChipEngine:
             if select in ("sort", "topk", "seg", "extract") and kcap < n:
                 ks_pad = np.ones(qpad, np.int32)
                 ks_pad[:nq] = sub.ks
-                cols_dev = _boundary_cols(top.dists, jnp.asarray(ks_pad))
+                cols_dev = _boundary_cols(top.dists, jax.device_put(ks_pad))
 
             t0 = _time.perf_counter()
             # NOTE: the "fetch" phase time includes the wait for all
@@ -1011,7 +1042,7 @@ class SingleChipEngine:
             fetch = ([] if self.config.exact else [top.dists]) + [top.ids] \
                 + ([cols_dev] if cols_dev is not None else [])
             with obs_span("single.fetch", select=select, kcap=kcap):
-                fetched = list(jax.device_get(fetch))
+                fetched = list(jax.device_get(fetch))  # check: allow-host-sync
             dists = None if self.config.exact \
                 else np.asarray(fetched.pop(0), np.float64)[:nq]
             ids = fetched.pop(0)[:nq]
@@ -1078,10 +1109,12 @@ class SingleChipEngine:
             ks_pad = np.zeros(qpad, np.int32)
             ks_pad[:nq] = sub.ks
 
-            p, i, d = _device_epilogue(top, jnp.asarray(ks_pad),
+            p, i, d = _device_epilogue(top, jax.device_put(ks_pad),
                                        num_labels=num_labels)
-            preds = np.asarray(p)[:nq]
-            rids = np.asarray(i)[:nq]
+            # check: allow-host-sync
+            p, i, d = jax.device_get((p, i, d))
+            preds = p[:nq]
+            rids = i[:nq]
             rd = np.asarray(d, np.float64)[:nq]
             gids = np.arange(nq) if idx is None else idx
             for qi in range(nq):

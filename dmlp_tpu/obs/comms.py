@@ -127,6 +127,44 @@ def moe_a2a_traffic(ep: int, capacity: int, hidden: int,
                                   f"+ {meta} B meta, (ep-1)/ep off-device")
 
 
+def tp_psum_activation_traffic(tp: int, rows: int, hidden: int,
+                               n_pairs: int = 1, ticks: int = 1,
+                               itemsize: int = 4, n_groups: int = 1,
+                               count: int = 1) -> CollectiveTraffic:
+    """The tensor-parallel activation ``psum``
+    (train.pipeline._stage_block3: each col/row layer pair ends in ONE
+    psum of the (rows, hidden) f32 activation block over the tp axis).
+    Ring all-reduce bound per psum — 2*(tp-1)/tp of the block; a stage
+    runs ``n_pairs`` pairs per schedule tick and ``ticks`` ticks per
+    step, and the backward pass's transposed psums mirror the forward
+    1:1 (fold them via ``count``, like the pipeline ppermute record)."""
+    nbytes = rows * hidden * itemsize
+    per = 0 if tp <= 1 else round(2 * (tp - 1) * nbytes / tp)
+    per_dev = per * n_pairs * ticks
+    return CollectiveTraffic("psum_tp_activations", "tp", tp, per_dev,
+                             per_dev, n_groups=n_groups, count=count,
+                             note=f"{ticks} ticks x {n_pairs} pairs x "
+                                  f"ring all-reduce of {nbytes} B "
+                                  f"activations")
+
+
+def ep_psum_combine_traffic(ep: int, tokens: int, hidden: int,
+                            itemsize: int = 4, n_groups: int = 1,
+                            count: int = 1) -> CollectiveTraffic:
+    """The dense (capacity-free) MoE combine
+    (train.experts._moe_body): every cell computes its local experts'
+    contribution for ALL dp-local tokens and one ``psum`` over the ep
+    axis combines the (tokens, hidden) partials. Ring all-reduce bound
+    per step; like the a2a record this counts the forward dispatch per
+    step (``count`` folds steps)."""
+    nbytes = tokens * hidden * itemsize
+    per_dev = 0 if ep <= 1 else round(2 * (ep - 1) * nbytes / ep)
+    return CollectiveTraffic("psum_ep_combine", "ep", ep, per_dev,
+                             per_dev, n_groups=n_groups, count=count,
+                             note=f"ring all-reduce of {nbytes} B "
+                                  f"expert-output partials")
+
+
 def pipeline_ppermute_traffic(pp: int, n_micro: int, micro_rows: int,
                               hidden: int, schedule: str = "gpipe",
                               n_virtual: int = 1, itemsize: int = 4,
@@ -216,14 +254,19 @@ def summarize(traffics: List[CollectiveTraffic]) -> Dict[str, object]:
 def train_step_comms(param_bytes: int, mesh_shape, steps: int = 1,
                      moe: Optional[dict] = None,
                      pipeline: Optional[dict] = None,
+                     moe_dense: Optional[dict] = None,
                      ) -> List[CollectiveTraffic]:
     """Per-run traffic for the train loop's collective paths: the grad
     ``psum`` over the dp axis, plus the MoE all-to-all when the a2a
     dispatch runs (``moe`` = {"ep", "capacity", "hidden"}), plus the
-    pipeline's activation ``ppermute`` when the dp_pp/dp_pp3 step runs
+    dense MoE's ep combine ``psum`` (``moe_dense`` = {"ep", "tokens",
+    "hidden"}), plus the pipeline's activation ``ppermute`` when the
+    dp_pp/dp_pp3 step runs
     (``pipeline`` = {"pp", "n_micro", "micro_rows", "hidden"}
-    [+ "schedule", "n_virtual"]; the record covers forward AND the
-    mirrored backward-schedule permutes — 2x per step).
+    [+ "schedule", "n_virtual", "tp", "n_pairs"]; a "tp" > 1 adds the
+    dp_pp3 stage blocks' per-pair activation psum over the tp axis; the
+    records cover forward AND the mirrored backward-schedule
+    permutes/psums — 2x per step).
 
     ``param_bytes`` is the GLOBAL parameter footprint; every non-dp mesh
     axis (tp / pp / ep) shards the parameters — and hence the gradients
@@ -245,6 +288,10 @@ def train_step_comms(param_bytes: int, mesh_shape, steps: int = 1,
         out.append(moe_a2a_traffic(moe["ep"], moe["capacity"],
                                    moe["hidden"], n_groups=dp,
                                    count=steps))
+    if moe_dense:
+        out.append(ep_psum_combine_traffic(
+            moe_dense["ep"], moe_dense["tokens"], moe_dense["hidden"],
+            n_groups=dp, count=steps))
     if pipeline:
         out.append(pipeline_ppermute_traffic(
             pipeline["pp"], pipeline["n_micro"], pipeline["micro_rows"],
@@ -252,4 +299,14 @@ def train_step_comms(param_bytes: int, mesh_shape, steps: int = 1,
             n_virtual=pipeline.get("n_virtual", 1),
             n_groups=pipeline.get("n_groups", dp),
             count=2 * steps))  # forward + reverse-schedule backward
+        tp = pipeline.get("tp", 1)
+        if tp > 1:
+            # dp_pp3 stage blocks: one activation psum per col/row pair
+            # per gpipe tick, independent per (dp, pp) cell group.
+            pp, n_micro = pipeline["pp"], pipeline["n_micro"]
+            out.append(tp_psum_activation_traffic(
+                tp, pipeline["micro_rows"], pipeline["hidden"],
+                n_pairs=pipeline.get("n_pairs", 2),
+                ticks=n_micro + pp - 1,
+                n_groups=dp * pp, count=2 * steps))
     return out

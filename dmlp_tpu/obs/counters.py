@@ -30,7 +30,7 @@ Import-light: jax is imported lazily, only when a probe is actually used.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 __all__ = ["CostProbe", "normalize_cost", "lowered_cost", "roofline",
            "install", "uninstall", "active", "record_dispatch",

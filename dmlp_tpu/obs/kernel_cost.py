@@ -42,7 +42,7 @@ actually resolved.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Dict, Optional
 
 __all__ = ["extract_topk_cost", "extract_loop_cost",
            "fused_dist_segmin_cost", "analytic_cost"]

@@ -22,7 +22,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from dmlp_tpu.utils.compat import tpu_compiler_params
 
@@ -146,6 +145,7 @@ def native_pallas_backend() -> bool:
         x = jnp.zeros((8, 128), jnp.float32)
         out = pl.pallas_call(
             probe, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))(x)
+        # One-time cached probe readback.  # check: allow-host-sync
         return bool(jax.device_get(out)[0, 0] == 1.0)
     except Exception:
         return False

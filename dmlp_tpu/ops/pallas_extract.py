@@ -320,6 +320,16 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
     """
     v = _resolve_variant(kc, d_attrs.shape[0], q_attrs.shape[0],
                          q_attrs.shape[1])
+    # Eager callers pass plain ints for the traced SMEM scalars; under
+    # the sanitizer's transfer guard the jit argument conversion would
+    # be an implicit host->device transfer — make it explicit here (a
+    # traced value, e.g. from the mesh engines' shard_map bodies, passes
+    # through untouched).
+    import numpy as _onp
+    if isinstance(n_real, (int, _onp.integer)):
+        n_real = jax.device_put(_onp.int32(n_real))
+    if isinstance(id_base, (int, _onp.integer)):
+        id_base = jax.device_put(_onp.int32(id_base))
     return _extract_topk_jit(
         q_attrs, d_attrs, carry_d, carry_i, n_real=n_real,
         id_base=id_base, kc=kc, interpret=interpret,

@@ -21,6 +21,8 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -134,8 +136,15 @@ def streaming_topk(query_attrs: jax.Array, data_attrs: jax.Array,
     return out
 
 
+@functools.partial(jax.jit, static_argnames=("qb", "k", "accum_dtype"))
 def init_topk(qb: int, k: int, accum_dtype=jnp.float32) -> TopK:
-    """Empty running top-k carry: all slots (+inf, -1, -1)."""
+    """Empty running top-k carry: all slots (+inf, -1, -1).
+
+    Jitted (all-static args, one cached constant program per shape) so
+    the eager chunk drivers can build carries under the sanitizer's
+    transfer guard — eager ``jnp.full`` materializes its fill value via
+    an implicit host->device transfer, which ``--sanitize`` disallows.
+    """
     return TopK(
         jnp.full((qb, k), jnp.inf, accum_dtype),
         jnp.full((qb, k), -1, jnp.int32),

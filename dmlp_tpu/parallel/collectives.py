@@ -28,6 +28,7 @@ from dmlp_tpu.utils.compat import axis_size
 def allgather_merge_topk(local: TopK, k: int, axis_name: str) -> TopK:
     """All-gather per-shard candidates along ``axis_name`` and re-select k."""
     gathered = jax.tree.map(
+        # check: comms-model=allgather_topk_traffic
         lambda x: jax.lax.all_gather(x, axis_name, axis=0, tiled=False), local)
     # (R, Q, K) -> (Q, R*K): per query, concatenate all shards' candidates.
     def flatten(x):
@@ -57,6 +58,7 @@ def ring_allreduce_topk(local: TopK, k: int, axis_name: str) -> TopK:
 
     def body(acc: TopK, _):
         incoming = jax.tree.map(
+            # check: comms-model=ring_topk_traffic
             lambda x: jax.lax.ppermute(x, axis_name, perm), acc)
         return merge_topk(incoming, local, k), None
 

@@ -22,7 +22,6 @@ import os
 import time
 
 import jax
-import numpy as np
 
 
 def _env_int(name: str, default: int) -> int:

@@ -127,6 +127,7 @@ def _moe_body(params, x, y, *, n_experts: int, n_classes: int):
     act = jax.nn.relu(up)
     down = jnp.einsum("ebf,efh->ebh", act, params["down"])
     local_out = jnp.einsum("ebh,be->bh", down, local_hot)
+    # check: comms-model=ep_psum_combine_traffic
     expert_out = jax.lax.psum(local_out, EP_AXIS)       # combine over ep
     h = h + gate * expert_out
 
@@ -209,8 +210,8 @@ def _moe_a2a_body(params, x, y, *, n_experts: int, n_classes: int,
 
     # Dispatch over ICI: slot [s, c] on this cell is now source cell s's
     # c-th token destined to OUR experts.
-    recv = jax.lax.all_to_all(send, EP_AXIS, 0, 0)
-    rmeta = jax.lax.all_to_all(meta, EP_AXIS, 0, 0)
+    recv = jax.lax.all_to_all(send, EP_AXIS, 0, 0)   # check: comms-model=moe_a2a_traffic
+    rmeta = jax.lax.all_to_all(meta, EP_AXIS, 0, 0)  # check: comms-model=moe_a2a_traffic
 
     toks = recv.reshape(n_ep * capacity, hdim)
     tmeta = rmeta.reshape(n_ep * capacity)
@@ -222,6 +223,7 @@ def _moe_a2a_body(params, x, y, *, n_experts: int, n_classes: int,
     out_toks = jnp.einsum("teh,te->th", down, ehot)
 
     # Return through the reverse all_to_all (same slot layout back).
+    # check: comms-model=moe_a2a_traffic
     ret = jax.lax.all_to_all(
         out_toks.reshape(n_ep, capacity, hdim), EP_AXIS, 0, 0)
     # Gather back with in-range indices (dropped tokens read slot (0, 0)

@@ -28,7 +28,6 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from dmlp_tpu.obs.trace import span as obs_span
 from dmlp_tpu.train import checkpoint as ckpt_lib
@@ -230,7 +229,8 @@ def train(steps: int = 100, batch: int = 1024,
           log_every: int = 10, offload=False, parallelism: str = "dp_tp",
           n_micro: int = 4, n_experts: int = 8,
           moe_dispatch: str = "dense", capacity_factor: float = 1.0,
-          pp_schedule: str = "gpipe", n_virtual: int = 2):
+          pp_schedule: str = "gpipe", n_virtual: int = 2,
+          sanitize: bool = False):
     optimizer = make_optimizer(optimizer_name, lr)
     mesh, state, step_fn, (d_in, n_classes), shardings = _build_parallel(
         parallelism, mesh_shape, tuple(dims), optimizer, compute_dtype,
@@ -258,12 +258,21 @@ def train(steps: int = 100, batch: int = 1024,
         if comms is not None:
             metrics.log(event="comms", **comms)
 
+    # Sanitized training: transfer guard + leak check + debug_nans around
+    # the step loop (dmlp_tpu.check.sanitize). The readbacks below are
+    # explicit device_get / post-device_get floats, so a clean loop is
+    # byte-identical; a NaN-producing step raises AT the op.
+    from dmlp_tpu.check.sanitize import maybe_sanitized
+
+    def san():  # fresh context per step: @contextmanager cms are one-shot
+        return maybe_sanitized(train=True, force=sanitize)
+
     last = {}
     t_window = time.perf_counter()
     window_steps = 0
     for i in range(start_step, start_step + steps):
         xd, yd = next(data)
-        with obs_span("train.step"):
+        with obs_span("train.step"), san():
             state, m = step_fn(state, xd, yd)
         window_steps += 1
         if (i + 1) % log_every == 0 or i + 1 == start_step + steps:
@@ -301,11 +310,19 @@ def _train_comms(state, mesh, parallelism: str, dims, batch: int,
         _np.prod(x.shape) * x.dtype.itemsize
         for x in jax.tree_util.tree_leaves(state["params"])))
     moe = None
+    moe_dense = None
     if parallelism == "dp_ep" and moe_dispatch == "a2a":
         from dmlp_tpu.train.experts import a2a_capacity
         dp, ep = mesh.devices.shape
         moe = {"ep": ep, "hidden": dims[1],
                "capacity": a2a_capacity(batch, dp, ep, capacity_factor)}
+    elif parallelism == "dp_ep":
+        # Dense one-hot dispatch: the combine is ONE ep psum of the
+        # (dp-local tokens, hidden) partials per step
+        # (experts._moe_body; obs.comms.ep_psum_combine_traffic).
+        dp, ep = mesh.devices.shape
+        moe_dense = {"ep": ep, "hidden": dims[1],
+                     "tokens": max(batch // dp, 1)}
     pipeline = None
     if parallelism in ("dp_pp", "dp_pp3"):
         # Activation hand-off shapes exactly as the step dispatches them:
@@ -320,9 +337,15 @@ def _train_comms(state, mesh, parallelism: str, dims, batch: int,
                     "hidden": dims[1], "schedule": sched,
                     "n_virtual": n_virtual if sched == "interleaved" else 1,
                     "n_groups": groups}
+        if parallelism == "dp_pp3":
+            # dp_pp3 stage blocks psum each col/row pair's activation
+            # over tp (pipeline._stage_block3; 2 pairs per stage).
+            pipeline["tp"] = mesh.devices.shape[1]
+            pipeline["n_pairs"] = 2
     traffic = obs_comms.train_step_comms(param_bytes, mesh.devices.shape,
                                          steps=steps, moe=moe,
-                                         pipeline=pipeline)
+                                         pipeline=pipeline,
+                                         moe_dense=moe_dense)
     return obs_comms.summarize(traffic) if traffic else None
 
 
@@ -382,6 +405,11 @@ def main(argv=None) -> int:
                    help="write a Perfetto/Chrome-trace JSON of the run's "
                         "step/checkpoint spans to FILE (obs.trace)")
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--sanitize", action="store_true",
+                   help="wrap every train step in jax.transfer_guard("
+                        "'disallow') + jax.checking_leaks + "
+                        "jax.debug_nans (dmlp_tpu.check.sanitize); "
+                        "$DMLP_TPU_SANITIZE=1 enables it too")
     p.add_argument("--offload", nargs="?", const="all", default="none",
                    choices=["none", "params", "all"],
                    help="host-DRAM offload level: 'params' keeps moments "
@@ -414,7 +442,8 @@ def main(argv=None) -> int:
                 moe_dispatch=args.moe_dispatch,
                 capacity_factor=args.capacity_factor,
                 pp_schedule=args.pp_schedule,
-                n_virtual=args.virtual_stages)
+                n_virtual=args.virtual_stages,
+                sanitize=args.sanitize)
     finally:
         if tracer is not None:
             from dmlp_tpu.obs import trace as obs_trace

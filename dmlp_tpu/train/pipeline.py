@@ -183,6 +183,7 @@ def _pp_body(params, x, y, *, n_stages: int, n_micro: int, n_classes: int):
             ys)
         # Hand the activation to the next stage (stage 0 receives zeros;
         # the last stage's output is not forwarded).
+        # check: comms-model=pipeline_ppermute_traffic
         act = jax.lax.ppermute(out, PP_AXIS, perm) if n_stages > 1 else out
         return (act, ys), None
 
@@ -351,6 +352,7 @@ def _ppi_body(params, x, y, *, n_stages: int, n_micro: int, n_virtual: int,
             jax.lax.dynamic_update_index_in_dim(
                 ys, out, jnp.clip(m, 0, n_micro - 1), 0),
             ys)
+        # check: comms-model=pipeline_ppermute_traffic
         act = jax.lax.ppermute(out, PP_AXIS, ring) if n_stages > 1 else out
         return (act, ys), None
 
@@ -498,7 +500,7 @@ def _stage_block3(wc, bc, wr, br, h):
                                 preferred_element_type=jnp.float32) + bci)
         v = jax.lax.dot_general(u, wri, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        v = jax.lax.psum(v, TP_AXIS)
+        v = jax.lax.psum(v, TP_AXIS)  # check: comms-model=tp_psum_activation_traffic
         return jax.nn.relu(v + bri).astype(h.dtype), None
     h, _ = jax.lax.scan(pair, h, (wc, bc, wr, br))
     return h
@@ -529,6 +531,7 @@ def _pp3_body(params, x, y, *, n_stages: int, n_micro: int, n_classes: int):
             jax.lax.dynamic_update_index_in_dim(
                 ys, out, jnp.clip(m, 0, n_micro - 1), 0),
             ys)
+        # check: comms-model=pipeline_ppermute_traffic
         act = jax.lax.ppermute(out, PP_AXIS, perm) if n_stages > 1 else out
         return (act, ys), None
 

@@ -1,0 +1,599 @@
+"""dmlp_tpu.check — the static analysis suite.
+
+Three layers: (1) fixture snippets per rule family, positive AND
+negative, proving each seeded violation class is caught and each
+legitimate idiom is not; (2) the REAL package, which must be clean of
+non-baselined findings (the committed baseline is empty — keep it so);
+(3) the baseline round-trip (new finding fails -> baselined passes ->
+fixed reports stale) and the ``--json`` CLI contract.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from dmlp_tpu.check.analyzer import (analyze_package, analyze_paths,
+                                     package_root)
+from dmlp_tpu.check.baseline import (diff_baseline, load_baseline,
+                                     save_baseline)
+
+
+def write(tmp_path, rel, source):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return str(p)
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+def run_check(tmp_path, families):
+    return analyze_paths([str(tmp_path)], families, root=str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# R1 — collective-axis contract
+# ---------------------------------------------------------------------------
+
+MESH_SRC = """
+DATA_AXIS = "data"
+QUERY_AXIS = "query"
+"""
+
+
+class TestR1Collectives:
+    def test_r101_undeclared_axis_caught(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import jax
+            def f(x):
+                return jax.lax.psum(x, "bogus")
+        """)
+        fs = run_check(tmp_path, ["R1"])
+        assert "R101" in rules_of(fs)
+        assert any("bogus" in f.message for f in fs)
+
+    def test_r101_declared_axis_clean_incl_constant(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import jax
+            from dmlp_tpu.parallel.mesh import DATA_AXIS
+            def f(x):
+                return jax.lax.psum(x, DATA_AXIS) + \\
+                    jax.lax.axis_index("query")
+        """)
+        assert run_check(tmp_path, ["R1"]) == []
+
+    def test_r102_axis_not_in_shard_map_specs(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import jax
+            from dmlp_tpu.utils.compat import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def build(mesh):
+                def local(a):
+                    return jax.lax.psum(a, "query")  # check: no-traffic
+                return shard_map(local, mesh=mesh,
+                                 in_specs=(P("data"),),
+                                 out_specs=P("data"))
+        """)
+        fs = run_check(tmp_path, ["R1"])
+        assert "R102" in rules_of(fs)
+
+    def test_r102_spec_axis_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import jax
+            from dmlp_tpu.utils.compat import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def build(mesh):
+                def local(a):
+                    return jax.lax.psum(a, "data")  # check: no-traffic
+                return shard_map(local, mesh=mesh,
+                                 in_specs=(P("data"),),
+                                 out_specs=P("data"))
+        """)
+        assert run_check(tmp_path, ["R1"]) == []
+
+    def test_r103_unannotated_traffic_collective(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/train/x.py", """
+            import jax
+            def f(x):
+                return jax.lax.psum(x, "data")
+        """)
+        assert "R103" in rules_of(run_check(tmp_path, ["R1"]))
+
+    def test_r103_annotated_with_real_model_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/obs/comms.py", """
+            def psum_traffic(nbytes, axis_size):
+                return nbytes
+        """)
+        write(tmp_path, "dmlp_tpu/train/x.py", """
+            import jax
+            def f(x):
+                # check: comms-model=psum_traffic
+                return jax.lax.psum(x, "data")
+        """)
+        assert run_check(tmp_path, ["R1"]) == []
+
+    def test_r104_annotation_names_missing_model(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/obs/comms.py", "def real_model():\n    pass\n")
+        write(tmp_path, "dmlp_tpu/train/x.py", """
+            import jax
+            def f(x):
+                # check: comms-model=renamed_away_traffic
+                return jax.lax.psum(x, "data")
+        """)
+        assert "R104" in rules_of(run_check(tmp_path, ["R1"]))
+
+    def test_axis_helper_call_site_checked(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/parallel/helpers.py", """
+            import jax
+            def merge(local, k, axis_name):
+                # check: comms-model=m
+                return jax.lax.all_gather(local, axis_name)
+        """)
+        write(tmp_path, "dmlp_tpu/obs/comms.py", "def m():\n    pass\n")
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from dmlp_tpu.parallel.helpers import merge
+            def f(local, k):
+                return merge(local, k, "not_an_axis")
+        """)
+        fs = run_check(tmp_path, ["R1"])
+        assert "R101" in rules_of(fs)
+        assert any(f.path.endswith("engine/x.py") for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# R2 — recompilation hazards
+# ---------------------------------------------------------------------------
+
+
+class TestR2Recompile:
+    def test_r201_mutable_default_on_jit(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import jax
+            @jax.jit
+            def f(x, opts=[]):
+                return x
+        """)
+        assert "R201" in rules_of(run_check(tmp_path, ["R2"]))
+
+    def test_r202_fstring_in_jit_body(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import jax
+            @jax.jit
+            def f(x):
+                name = f"variant_{x.shape}"
+                return x, name
+        """)
+        assert "R202" in rules_of(run_check(tmp_path, ["R2"]))
+
+    def test_r202_fstring_in_raise_is_fine(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import jax
+            @jax.jit
+            def f(x):
+                if x.shape[0] % 8:
+                    raise ValueError(f"bad shape {x.shape}")
+                return x
+        """)
+        assert run_check(tmp_path, ["R2"]) == []
+
+    def test_r203_variant_resolution_inside_jit(self, tmp_path):
+        # The PR 3 review bug, reduced: lookup_variant consulted inside
+        # the traced body -> stale-trace reuse after a cache update.
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import jax
+            from dmlp_tpu.tune import lookup_variant
+            @jax.jit
+            def f(x):
+                v = lookup_variant(8, x.shape[0])
+                return x * v["ne"]
+        """)
+        assert "R203" in rules_of(run_check(tmp_path, ["R2"]))
+
+    def test_r203_resolution_outside_jit_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import jax
+            from dmlp_tpu.tune import lookup_variant
+            @jax.jit
+            def _impl(x, ne):
+                return x * ne
+            def f(x):
+                v = lookup_variant(8, x.shape[0])
+                return _impl(x, v["ne"])
+        """)
+        assert run_check(tmp_path, ["R2"]) == []
+
+    def test_r204_obviously_static_kwonly_missing(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import functools
+            import jax
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def f(x, *, k, select):
+                return x[:k] if select == "sort" else x
+        """)
+        fs = run_check(tmp_path, ["R2"])
+        assert "R204" in rules_of(fs)
+        assert any("select" in f.message for f in fs)
+
+    def test_r204_traced_kwonly_names_not_flagged(self, tmp_path):
+        # n_real/id_base/floor style params are legitimately traced.
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import functools
+            import jax
+            @functools.partial(jax.jit, static_argnames=("kc",))
+            def f(x, *, n_real, id_base, kc, floor):
+                return x[:kc] + n_real + id_base
+        """)
+        assert run_check(tmp_path, ["R2"]) == []
+
+    def test_r205_closure_over_module_mutable(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import jax
+            _CACHE = {}
+            @jax.jit
+            def f(x):
+                return x * len(_CACHE)
+        """)
+        assert "R205" in rules_of(run_check(tmp_path, ["R2"]))
+
+    def test_shard_mapped_body_is_traced_too(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from dmlp_tpu.utils.compat import shard_map
+            def build(mesh, specs):
+                def local(a):
+                    tag = f"cell_{a.shape}"
+                    return a, tag
+                return shard_map(local, mesh=mesh, in_specs=specs,
+                                 out_specs=specs)
+        """)
+        assert "R202" in rules_of(run_check(tmp_path, ["R2"]))
+
+
+# ---------------------------------------------------------------------------
+# R3 — host-sync hazards
+# ---------------------------------------------------------------------------
+
+
+class TestR3HostSync:
+    def test_r301_item(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            def f(arr):
+                return arr.item()
+        """)
+        assert "R301" in rules_of(run_check(tmp_path, ["R3"]))
+
+    def test_r302_device_get_needs_annotation(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import jax
+            def f(arr):
+                return jax.device_get(arr)
+        """)
+        assert "R302" in rules_of(run_check(tmp_path, ["R3"]))
+
+    def test_allowlist_comment_silences(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import jax
+            def f(arr):
+                return jax.device_get(arr)  # check: allow-host-sync
+        """)
+        assert run_check(tmp_path, ["R3"]) == []
+
+    def test_trailing_allowlist_does_not_leak_to_next_line(self, tmp_path):
+        # A trailing directive covers ITS statement only; the
+        # un-annotated implicit transfer on the next line must still
+        # flag (review finding: `lineno - 1` lookups silently widened
+        # every allowlist by one line).
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import jax
+            import numpy as np
+            import jax.numpy as jnp
+            def f(x):
+                fetched = jax.device_get(x)  # check: allow-host-sync
+                return np.asarray(jnp.sum(x))
+        """)
+        assert "R304" in rules_of(run_check(tmp_path, ["R3"]))
+
+    def test_r303_float_on_device_expr(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import jax.numpy as jnp
+            def f(a, b):
+                s = jnp.dot(a, b)
+                return float(s)
+        """)
+        assert "R303" in rules_of(run_check(tmp_path, ["R3"]))
+
+    def test_r304_np_asarray_on_device_expr(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import numpy as np
+            import jax.numpy as jnp
+            def f(a):
+                out = jnp.sort(a)
+                return np.asarray(out)
+        """)
+        assert "R304" in rules_of(run_check(tmp_path, ["R3"]))
+
+    def test_device_get_launders_taint(self, tmp_path):
+        # The sanctioned pattern: explicit fence, then host math freely.
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import jax
+            import numpy as np
+            import jax.numpy as jnp
+            def f(a):
+                out = jnp.sort(a)
+                # check: allow-host-sync
+                out = jax.device_get(out)
+                return float(np.asarray(out)[0])
+        """)
+        assert run_check(tmp_path, ["R3"]) == []
+
+    def test_host_numpy_untouched(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import numpy as np
+            def f(attrs):
+                a = np.zeros((8, 4), np.float32)
+                a[:4] = attrs
+                return float(np.einsum("na,na->n", a, a).max())
+        """)
+        assert run_check(tmp_path, ["R3"]) == []
+
+    def test_r305_branch_on_traced_value(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import jax
+            import jax.numpy as jnp
+            @jax.jit
+            def f(x):
+                if jnp.sum(x) > 0:
+                    return x
+                return -x
+        """)
+        assert "R305" in rules_of(run_check(tmp_path, ["R3"]))
+
+    def test_is_none_branch_in_jit_is_fine(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import jax
+            import jax.numpy as jnp
+            @jax.jit
+            def f(x, carry):
+                if carry is None:
+                    carry = jnp.zeros_like(x)
+                return x + carry
+        """)
+        assert run_check(tmp_path, ["R3"]) == []
+
+    def test_out_of_scope_dirs_ignored(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/obs/x.py", """
+            def f(arr):
+                return arr.item()
+        """)
+        assert run_check(tmp_path, ["R3"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R4 — compat-bypass
+# ---------------------------------------------------------------------------
+
+
+class TestR4Compat:
+    def test_r401_shard_map_import(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from jax.experimental.shard_map import shard_map
+        """)
+        assert "R401" in rules_of(run_check(tmp_path, ["R4"]))
+
+    def test_r402_axis_size_attr(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/train/x.py", """
+            import jax
+            def f(ax):
+                return jax.lax.axis_size(ax)
+        """)
+        assert "R402" in rules_of(run_check(tmp_path, ["R4"]))
+
+    def test_r403_compiler_params_attr(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            from jax.experimental.pallas import tpu as pltpu
+            def f():
+                return pltpu.CompilerParams()
+        """)
+        assert "R403" in rules_of(run_check(tmp_path, ["R4"]))
+
+    def test_r404_memory_kind_literal(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/train/x.py", """
+            def f(sharding):
+                return sharding.with_memory_kind("pinned_host")
+        """)
+        assert "R404" in rules_of(run_check(tmp_path, ["R4"]))
+
+    def test_compat_module_exempt(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/utils/compat.py", """
+            import jax
+            def axis_size(ax):
+                if hasattr(jax.lax, "axis_size"):
+                    return jax.lax.axis_size(ax)
+                return jax.lax.psum(1, ax)
+            def host_memory_kind():
+                return "pinned_host"
+        """)
+        assert run_check(tmp_path, ["R4"]) == []
+
+    def test_docstring_mention_not_flagged(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/train/x.py", '''
+            def f():
+                """Docs may say "pinned_host" freely."""
+                return None
+        ''')
+        assert run_check(tmp_path, ["R4"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R0 — hygiene (the ruff-subset fallback behind make lint)
+# ---------------------------------------------------------------------------
+
+
+class TestR0Hygiene:
+    def test_unused_import(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/x.py", """
+            import os
+            import sys
+            print(sys.argv)
+        """)
+        fs = run_check(tmp_path, ["R0"])
+        assert rules_of(fs) == ["R001"]
+        assert "os" in fs[0].message
+
+    def test_noqa_and_init_reexports_respected(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/x.py", """
+            import os  # noqa: F401
+        """)
+        write(tmp_path, "dmlp_tpu/__init__.py", """
+            from dmlp_tpu.x import thing
+        """)
+        assert run_check(tmp_path, ["R0"]) == []
+
+    def test_bare_except(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/x.py", """
+            def f():
+                try:
+                    return 1
+                except:
+                    return 0
+        """)
+        assert "R002" in rules_of(run_check(tmp_path, ["R0"]))
+
+    def test_mutable_default(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/x.py", """
+            def f(xs=[]):
+                return xs
+        """)
+        assert "R003" in rules_of(run_check(tmp_path, ["R0"]))
+
+    def test_fstring_without_placeholder(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/x.py", """
+            def f():
+                return f"static text"
+        """)
+        assert "R004" in rules_of(run_check(tmp_path, ["R0"]))
+
+    def test_format_spec_fstrings_not_flagged(self, tmp_path):
+        # py3.10 nests the ":.6f" spec as its own JoinedStr — must not
+        # false-positive (the bug the first run over the tree surfaced).
+        write(tmp_path, "dmlp_tpu/x.py", """
+            def f(v):
+                return f"{v:.6f}"
+        """)
+        assert run_check(tmp_path, ["R0"]) == []
+
+
+# ---------------------------------------------------------------------------
+# the real package + baseline + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_real_package_clean_of_default_family_findings():
+    """R1-R4 over the installed package: zero findings. Anything new
+    must be fixed or explicitly baselined in check_baseline.json."""
+    assert analyze_package() == []
+
+
+def test_real_package_clean_of_hygiene_findings():
+    assert analyze_package(["R0"]) == []
+
+
+def test_committed_baseline_is_empty_and_loadable():
+    path = os.path.join(os.path.dirname(package_root()),
+                        "check_baseline.json")
+    assert os.path.exists(path), "check_baseline.json must be committed"
+    assert sum(load_baseline(path).values()) == 0
+
+
+VIOLATION = """
+import jax
+def f(x):
+    return jax.lax.psum(x, "bogus")
+"""
+
+
+class TestBaselineRoundTrip:
+    def test_new_finding_then_baseline_then_stale(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        src = write(tmp_path, "dmlp_tpu/ops/x.py", VIOLATION)
+        findings = run_check(tmp_path, ["R1"])
+        assert findings  # the seeded violation is caught
+
+        # un-baselined -> new (fails make check)
+        new, matched, stale = diff_baseline(findings, {})
+        assert new and not matched and not stale
+
+        # baselined -> passes
+        bl_path = str(tmp_path / "check_baseline.json")
+        save_baseline(bl_path, findings)
+        new, matched, stale = diff_baseline(findings,
+                                            load_baseline(bl_path))
+        assert not new and len(matched) == len(findings) and not stale
+
+        # baseline survives unrelated line shifts (fingerprint has no
+        # line numbers)
+        with open(src) as f:
+            shifted = "# a new comment line\n" + f.read()
+        open(src, "w").write(shifted)
+        findings2 = run_check(tmp_path, ["R1"])
+        new, matched, _ = diff_baseline(findings2, load_baseline(bl_path))
+        assert not new and matched
+
+        # fixed -> stale baseline entry reported, exit stays clean
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import jax
+            DATA = "data"
+            def f(x):
+                return jax.lax.psum(x, "data")  # check: no-traffic
+        """)
+        findings3 = run_check(tmp_path, ["R1"])
+        new, _, stale = diff_baseline(findings3, load_baseline(bl_path))
+        assert not new and stale
+
+
+class TestCLI:
+    def _run(self, args, cwd=None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "dmlp_tpu.check", *args],
+            capture_output=True, text=True, env=env, cwd=cwd)
+
+    def test_json_verdict_pure_stdout_and_exit_codes(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/ops/x.py", VIOLATION)
+        r = self._run(["--json", "--families", "R1", "--no-baseline",
+                       str(tmp_path / "dmlp_tpu")])
+        assert r.returncode == 1
+        verdict = json.loads(r.stdout)  # stdout is pure JSON
+        assert verdict["ok"] is False
+        assert any(f["rule"] == "R101" for f in verdict["new"])
+        assert "finding" in r.stderr  # narration on stderr
+
+    def test_write_baseline_then_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/ops/x.py", VIOLATION)
+        bl = str(tmp_path / "bl.json")
+        target = str(tmp_path / "dmlp_tpu")
+        assert self._run(["--families", "R1", "--write-baseline",
+                          "--baseline", bl, target]).returncode == 0
+        r = self._run(["--families", "R1", "--baseline", bl, target])
+        assert r.returncode == 0
+
+    def test_list_rules(self, tmp_path):
+        r = self._run(["--list-rules"])
+        assert r.returncode == 0
+        for rule in ("R101", "R203", "R302", "R404", "R001"):
+            assert rule in r.stdout

@@ -1,7 +1,6 @@
 """Multi-host feed helpers: shard math, offset-indexed reads, global arrays."""
 
 import numpy as np
-import jax
 
 from dmlp_tpu.engine.sharded import ShardedEngine
 from dmlp_tpu.config import EngineConfig

@@ -232,6 +232,61 @@ def test_moe_a2a_traffic_hand_computed():
     assert t.bytes_out_per_device == 204
 
 
+def test_tp_psum_activation_traffic_hand_computed():
+    # tp=4, (rows=8, hidden=16) f32 block = 512 B; ring all-reduce moves
+    # 2*(4-1)/4 * 512 = 768 B per psum; 2 pairs x 3 ticks = 6 psums.
+    t = obs_comms.tp_psum_activation_traffic(4, 8, 16, n_pairs=2,
+                                             ticks=3)
+    assert t.bytes_out_per_device == 768 * 6
+    assert t.axis == "tp"
+    assert obs_comms.tp_psum_activation_traffic(
+        1, 8, 16).bytes_out_per_device == 0  # single tp cell: no wire
+
+
+def test_ep_psum_combine_traffic_hand_computed():
+    # ep=2, (tokens=16, hidden=8) f32 partials = 512 B; ring bound
+    # 2*(2-1)/2 * 512 = 512 B per device per step.
+    t = obs_comms.ep_psum_combine_traffic(2, 16, 8)
+    assert t.bytes_out_per_device == 512
+    assert t.collective == "psum_ep_combine"
+
+
+def test_train_step_comms_dense_moe_and_pp3_tp():
+    # Dense MoE: the ep combine psum record rides moe_dense.
+    out = obs_comms.train_step_comms(
+        0, (2, 2), steps=3, moe_dense={"ep": 2, "tokens": 16,
+                                       "hidden": 8})
+    kinds = [t.collective for t in out]
+    assert "psum_ep_combine" in kinds
+    ep = next(t for t in out if t.collective == "psum_ep_combine")
+    assert ep.count == 3 and ep.n_groups == 2  # per step, per dp group
+
+    # dp_pp3: pipeline dict with tp adds the per-pair activation psum
+    # next to the ppermute record (fwd+bwd -> count 2*steps).
+    out = obs_comms.train_step_comms(
+        1000, (2, 2, 2), steps=5,
+        pipeline={"pp": 2, "n_micro": 4, "micro_rows": 8, "hidden": 16,
+                  "tp": 2, "n_pairs": 2, "n_groups": 4})
+    kinds = [t.collective for t in out]
+    assert "ppermute_pipeline" in kinds and "psum_tp_activations" in kinds
+    tp = next(t for t in out if t.collective == "psum_tp_activations")
+    # ticks = n_micro + pp - 1 = 5; groups = dp*pp = 4; fwd+bwd count.
+    assert tp.count == 10 and tp.n_groups == 4
+    assert tp.bytes_out_per_device == \
+        round(2 * (2 - 1) * 8 * 16 * 4 / 2) * 2 * 5
+
+
+def test_every_hand_written_collective_site_has_a_live_model():
+    """The static analyzer's R1 coverage check, exercised as a test:
+    every traffic-bearing collective call site in engine/parallel/train
+    carries a comms-model annotation naming a function that exists in
+    obs/comms.py (R103/R104 both empty on the real tree)."""
+    from dmlp_tpu.check.analyzer import analyze_package
+    r1 = [f for f in analyze_package(["R1"])
+          if f.rule in ("R103", "R104")]
+    assert r1 == []
+
+
 def test_engine_comms_from_dispatch_shapes():
     single = obs_comms.engine_comms("allgather", (1, 4), 16, 8)
     assert single == []  # data axis of 1: no cross-shard merge

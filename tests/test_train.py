@@ -9,7 +9,7 @@ from dmlp_tpu.train.data import knn_input_batches, teacher_batches
 from dmlp_tpu.train.dryrun import dryrun_train
 from dmlp_tpu.train.loop import build_sharded_state, train
 from dmlp_tpu.train.metrics import throughput_metrics, train_step_flops
-from dmlp_tpu.train.model import init_mlp, mlp_apply, num_matmul_params
+from dmlp_tpu.train.model import init_mlp, num_matmul_params
 from dmlp_tpu.train.sharding import batch_shardings, make_train_mesh
 from dmlp_tpu.train.step import init_state, make_optimizer, make_train_step
 

@@ -26,7 +26,6 @@ grandfathered. Env: CAP_NUM_DATA, CAP_NUM_QUERIES, CAP_VALIDATE
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 import time

@@ -6,7 +6,6 @@ where the seg-select solve's per-chunk ~78 ms actually goes."""
 
 from __future__ import annotations
 
-import functools
 import json
 import sys
 import time
