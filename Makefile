@@ -6,7 +6,7 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
 .PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
-	perf-gate check lint clean
+	perf-gate check lint chaos-smoke clean
 
 all: native
 
@@ -15,7 +15,7 @@ native: native/_fastparse.so
 native/_fastparse.so: native/fastparse.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
-test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint
+test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint chaos-smoke
 	python -m pytest tests/ -q
 
 # Static analysis + runtime-sanitizer smoke (README "Static analysis &
@@ -128,6 +128,22 @@ perf-gate:
 	  --min-coverage 0.9
 	JAX_PLATFORMS=cpu python tools/perf_gate.py \
 	  --ledger outputs/LEDGER.json
+
+# Chaos smoke (README "Resilience & chaos testing"): bench config 1 and
+# a short --nan-guard train run replayed under three seeded fault
+# schedules (straggler delays, transient exceptions + corrupt parse,
+# simulated RESOURCE_EXHAUSTED driving the degradation ladder). Every
+# faulted run's output must be BYTE-IDENTICAL to the fault-free golden
+# run, faults must actually fire, recovery must be visible in the
+# resilience counters and resilience.* trace events, one schedule must
+# replay with a bit-identical injection log, and the zero-fault overhead
+# of the wrappers is measured with an interleaved on/off A/B into a
+# ledger-ingestible RunRecord.
+chaos-smoke:
+	mkdir -p outputs/chaos
+	rm -f outputs/chaos/CHAOS_SMOKE.jsonl
+	JAX_PLATFORMS=cpu python tools/chaos_run.py --smoke \
+	  --out outputs/chaos --record outputs/chaos/CHAOS_SMOKE.jsonl
 
 clean:
 	rm -f native/_fastparse.so

@@ -49,6 +49,11 @@ class BenchConfig:
     select: str = "auto"
     virtual_devices: int = 0  # 0 = whatever platform the env provides
     procs: int = 1            # jax.distributed process count
+    # Per-config engine kill timeout override (seconds); None = the
+    # harness-wide --timeout. A config that blows it records the
+    # explicit `timed_out` marker in its RunRecord (markers never
+    # gate) and the rest of the bench run proceeds.
+    timeout_s: Optional[float] = None
 
 
 BENCH_CONFIGS: Dict[int, BenchConfig] = {

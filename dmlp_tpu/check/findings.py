@@ -47,6 +47,9 @@ RULES = {
             " utils.compat.tpu_compiler_params)",
     "R404": "hard-coded host memory-kind string (use"
             " utils.compat.host_memory_kind)",
+    # R5 — resilience-path silent swallowing
+    "R501": "broad `except Exception` in a resilience-wrapped path"
+            " without re-raise or `# check: no-retry` annotation",
 }
 
 #: rule id -> allowlist directive that silences it at a call site.
@@ -56,6 +59,7 @@ ALLOW_DIRECTIVES = {
     "R2": "allow-recompile",
     "R3": "allow-host-sync",
     "R4": "allow-compat",
+    "R5": "no-retry",
 }
 
 

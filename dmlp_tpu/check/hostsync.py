@@ -91,7 +91,11 @@ def _taint_targets(target: ast.AST, tainted: Set[str]) -> None:
 
 #: wrappers _launders looks through to find the converting call
 _TRANSPARENT = {"list", "tuple", "sorted", "reversed"}
+#: resilient_get (engine.single) is the retry-wrapped jax.device_get —
+#: its one annotated device_get site is the fence, so its RESULT is a
+#: host value exactly like a direct device_get's.
 _LAUNDERING = set(_CONVERTERS) | {"jax.device_get", "device_get",
+                                  "resilient_get",
                                   "np.ascontiguousarray",
                                   "numpy.ascontiguousarray", "str"}
 

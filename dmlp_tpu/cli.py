@@ -104,6 +104,14 @@ def _emit_metrics(path: str, args, inp, timer: EngineTimer, phase_ms: dict,
         }
         if comms is not None:
             summary["comms"] = comms
+        # Recovery is never silent: when the resilience layer did
+        # anything (or a fault schedule was installed, even if nothing
+        # fired), the summary carries the counters the chaos harness
+        # asserts against.
+        from dmlp_tpu.resilience import inject as rs_inject
+        from dmlp_tpu.resilience import stats as rs_stats
+        if rs_stats.any_activity() or rs_inject.active() is not None:
+            summary["resilience"] = rs_stats.snapshot()
         mlog.log(**summary)
 
 
@@ -194,6 +202,12 @@ def main(argv: Optional[Sequence[str]] = None,
                              "serializing; $DMLP_TPU_SANITIZE=1 "
                              "enables it too. Output is byte-identical "
                              "on a clean program.")
+    parser.add_argument("--faults", metavar="FILE", default=None,
+                        help="deterministic fault-injection schedule "
+                             "(JSON; dmlp_tpu.resilience.inject) — the "
+                             "chaos harness's knob; $DMLP_TPU_FAULTS "
+                             "sets it too. Recovery must keep stdout "
+                             "byte-identical (make chaos-smoke)")
     args = parser.parse_args(argv)
 
     stdin = stdin or sys.stdin
@@ -208,9 +222,16 @@ def main(argv: Optional[Sequence[str]] = None,
     if args.metrics or args.counters:
         from dmlp_tpu.obs import counters as obs_counters
         probe = obs_counters.install()
+    from dmlp_tpu.resilience import inject as rs_inject
+    from dmlp_tpu.resilience import stats as rs_stats
+    rs_stats.reset()
+    schedule = rs_inject.install_from_env(args.faults)
     try:
         return _run_cli(parser, args, stdin, stdout, stderr, tracer, probe)
     finally:
+        if schedule is not None:
+            rs_inject.write_log_if_requested()
+            rs_inject.uninstall()
         if tracer is not None:
             from dmlp_tpu.obs import trace as obs_trace
             obs_trace.uninstall()

@@ -56,7 +56,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="per-rank cluster tracing: every rank writes "
                         "DIR/trace-rank<NN>.json (distinct Perfetto pid "
                         "per rank); merge with tools/merge_traces.py")
+    p.add_argument("--faults", metavar="FILE", default=None,
+                   help="deterministic fault-injection schedule (JSON; "
+                        "dmlp_tpu.resilience.inject); $DMLP_TPU_FAULTS "
+                        "sets it too")
+    p.add_argument("--supervise", type=int, default=None, metavar="N",
+                   help="launcher mode: spawn N rank processes of this "
+                        "entry under heartbeat + timeout supervision "
+                        "(resilience.supervise) — a dead or hung rank "
+                        "kills and relaunches the cluster (bounded), "
+                        "then falls back to a degraded single-process "
+                        "solve with identical contract checksums")
+    p.add_argument("--supervise-timeout", type=float, default=300.0,
+                   help="cluster deadline per supervised launch (s)")
+    p.add_argument("--supervise-dir", default=None,
+                   help="supervisor workdir for rank logs + heartbeat "
+                        "files (default: a temp dir)")
+    p.add_argument("--max-launches", type=int, default=2,
+                   help="supervised cluster launches before degrading "
+                        "to the single-process fallback")
     args = p.parse_args(argv)
+
+    if args.supervise is not None:
+        return _run_supervisor(args)
+
+    # Supervised ranks carry $DMLP_TPU_HEARTBEAT; beat so the
+    # supervisor can tell crashed/frozen from merely slow.
+    from dmlp_tpu.resilience.supervise import maybe_start_heartbeat_from_env
+    maybe_start_heartbeat_from_env()
+    from dmlp_tpu.resilience import inject as rs_inject
+    schedule = rs_inject.install_from_env(args.faults)
 
     from dmlp_tpu.parallel.distributed import (distributed_contract_run,
                                                initialize)
@@ -115,8 +144,92 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 tracer.write_rank_file(args.trace)
             finally:
                 obs_trace.uninstall()
+        if schedule is not None:
+            # $DMLP_TPU_FAULT_LOG determinism probe, per rank (ranks
+            # share the env, so multi-process runs suffix the path —
+            # one injection log per process, no last-writer-wins).
+            log_path = os.environ.get("DMLP_TPU_FAULT_LOG")
+            if log_path and (args.processes or 1) > 1:
+                log_path += f".rank{args.process_id or 0:02d}"
+            if log_path:
+                schedule.write_log(log_path)
+            rs_inject.uninstall()
     sys.stdout.write(buf.getvalue())
     sys.stdout.flush()
+    return 0
+
+
+def _run_supervisor(args) -> int:
+    """Launcher mode (``--supervise N``): build per-rank argvs of this
+    same entry (fresh coordinator port per attempt), run them under the
+    heartbeat/timeout supervision loop, and degrade to an in-process
+    single-process contract solve when every launch fails — the output
+    checksums are identical either way (that is the whole engine
+    contract), so a supervised run survives a broken cluster runtime
+    visibly but correctly."""
+    import io
+    import socket
+    import tempfile
+
+    workdir = args.supervise_dir or tempfile.mkdtemp(prefix="dmlp-sup-")
+    base = [sys.executable, "-m", "dmlp_tpu.distributed",
+            "--input", args.input, "--mode", args.mode,
+            "--select", args.select]
+    if args.mesh:
+        base += ["--mesh", args.mesh]
+    if args.data_block is not None:
+        base += ["--data-block", str(args.data_block)]
+    for flag, on in (("--pallas", args.pallas), ("--debug", args.debug),
+                     ("--warmup", args.warmup)):
+        if on:
+            base.append(flag)
+    if args.trace:
+        base += ["--trace", args.trace]
+    if args.faults:
+        base += ["--faults", args.faults]
+
+    def make_cluster(attempt: int):
+        # NOTE: same probe-then-rebind TOCTOU window as the bench
+        # harness's multiproc launcher; a lost port surfaces as a failed
+        # launch and the supervisor's relaunch is the retry.
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        return [base + ["--coordinator", f"localhost:{port}",
+                        "--processes", str(args.supervise),
+                        "--process-id", str(rank)]
+                for rank in range(args.supervise)]
+
+    def fallback():
+        from dmlp_tpu.cli import make_engine, parse_mesh_arg
+        from dmlp_tpu.parallel.distributed import distributed_contract_run
+        config = EngineConfig(mode=args.mode,
+                              mesh_shape=parse_mesh_arg(
+                                  argparse.ArgumentParser(), args.mesh),
+                              select=args.select,
+                              data_block=args.data_block,
+                              use_pallas=args.pallas, debug=args.debug)
+        engine = make_engine(config)
+        out, err = io.StringIO(), io.StringIO()
+        distributed_contract_run(args.input, engine, out=out, err=err,
+                                 warmup=args.warmup)
+        return out.getvalue().encode(), err.getvalue().encode()
+
+    from dmlp_tpu.resilience.supervise import run_supervised
+    out_b, err_b, report = run_supervised(
+        make_cluster, workdir,
+        cluster_timeout_s=args.supervise_timeout,
+        max_launches=args.max_launches, fallback=fallback)
+    for launch in report["launches"]:
+        if launch.get("failure"):
+            sys.stderr.write(f"supervise: launch {launch['attempt']} "
+                             f"failed: {launch['failure']}\n")
+    if report["fallback"]:
+        sys.stderr.write("supervise: degraded to single-process "
+                         "fallback (checksums unchanged)\n")
+    sys.stdout.buffer.write(out_b)
+    sys.stdout.flush()
+    sys.stderr.write(err_b.decode())
     return 0
 
 

@@ -33,7 +33,8 @@ from dmlp_tpu.engine.finalize import (boundary_overflow, finalize_host,
                                       repair_boundary_overflow, staging_eps)
 from dmlp_tpu.engine.single import (ChunkThrottle, MeasuredIters,
                                     fit_blocks, flush_measured_iters,
-                                    pad_dataset, resolve_kcap, round_up)
+                                    pad_dataset, resilient_get,
+                                    resolve_kcap, round_up)
 from dmlp_tpu.io.grammar import KNNInput
 from dmlp_tpu.io.report import QueryResult
 from dmlp_tpu.obs import counters as obs_counters
@@ -42,6 +43,8 @@ from dmlp_tpu.obs.trace import span as obs_span
 from dmlp_tpu.ops.topk import TopK, streaming_topk
 from dmlp_tpu.parallel.collectives import allgather_merge_topk, ring_allreduce_topk
 from dmlp_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS, make_mesh
+from dmlp_tpu.resilience import inject as rs_inject
+from dmlp_tpu.resilience import retry as rs_retry
 from dmlp_tpu.utils.compat import shard_map
 
 
@@ -559,8 +562,8 @@ class ShardedEngine:
             self._last_select = select  # run() gates the tie-overflow repair
             top = self._solve_merged(k, data_block, select, d_attrs,
                                      d_labels, d_ids, q_attrs)
-        # check: allow-host-sync
-        od, ol, oi = jax.device_get((top.dists, top.labels, top.ids))
+        od, ol, oi = resilient_get((top.dists, top.labels, top.ids),
+                                   site="sharded.fetch")
         out_np = (np.asarray(od, np.float64)[:nq], ol[:nq], oi[:nq])
         flush_measured_iters(self)  # post-fetch: a scalar readback
         return out_np
@@ -576,9 +579,15 @@ class ShardedEngine:
         r, c = self.mesh.devices.shape
         self.last_comms = engine_comms(self._merge_strategy, (r, c),
                                        q_attrs.shape[0] // c, k)
+        def _op():
+            rs_inject.fire("sharded.solve", which="merge")
+            return fn(*args)
+
         with obs_span("sharded.solve_merge", select=select, mesh=[r, c],
                       kcap=k) as sp:
-            top, its = fn(*args)
+            # Re-dispatching the jitted mesh program on the same placed
+            # arrays is idempotent — the retry wrapper's requirement.
+            top, its = rs_retry.call_with_retry(_op, "sharded.solve")
             sp.fence(top.dists)
         self._queue_iters("sharded.solve_merge", select, its,
                           q_attrs.shape[0] // c, d_attrs.shape[0] // r,
@@ -632,8 +641,13 @@ class ShardedEngine:
         select, data_block, k = self._plan_shard(d_attrs, q_attrs, kmax,
                                                  merged_width=True)
         r, c = self.mesh.devices.shape
-        top, its = self._fn(k, data_block, select)(d_attrs, d_labels,
-                                                   d_ids, q_attrs)
+        fn = self._fn(k, data_block, select)
+
+        def _op():
+            rs_inject.fire("sharded.solve", which="global")
+            return fn(d_attrs, d_labels, d_ids, q_attrs)
+
+        top, its = rs_retry.call_with_retry(_op, "sharded.solve")
         self._queue_iters("sharded.solve_global", select, its,
                           q_attrs.shape[0] // c, d_attrs.shape[0] // r,
                           d_attrs.shape[1], k)
@@ -720,9 +734,14 @@ class ShardedEngine:
                                           q_attrs),
                                      site="sharded.solve_local_shards")
         r, c = self.mesh.devices.shape
+
+        def _op():
+            rs_inject.fire("sharded.solve", which="local_shards")
+            return fn(d_attrs, d_labels, d_ids, q_attrs)
+
         with obs_span("sharded.solve_local_shards", select=select,
                       mesh=[r, c], kcap=k):
-            top, its = fn(d_attrs, d_labels, d_ids, q_attrs)
+            top, its = rs_retry.call_with_retry(_op, "sharded.solve")
         self._queue_iters("sharded.solve_local_shards", select, its,
                           q_attrs.shape[0] // c, d_attrs.shape[0] // r,
                           d_attrs.shape[1], k)
@@ -753,9 +772,8 @@ class ShardedEngine:
             # just readback bytes.
             t0 = _time.perf_counter()
             with obs_span("sharded.fetch", select=select):
-                # check: allow-host-sync
-                od, ol, oi = jax.device_get((top.dists, top.labels,
-                                             top.ids))
+                od, ol, oi = resilient_get((top.dists, top.labels,
+                                            top.ids), site="sharded.fetch")
                 dists = np.asarray(od, np.float64)[:nq]
                 labels = ol[:nq]
                 ids = oi[:nq]
@@ -878,8 +896,7 @@ class ShardedEngine:
                 p, i, d = _device_epilogue(
                     top, jax.device_put(ks_pad, ksh),
                     num_labels=num_labels)
-                # check: allow-host-sync
-                p, i, d = jax.device_get((p, i, d))
+                p, i, d = resilient_get((p, i, d), site="sharded.fetch")
                 preds = p[:nqs]
                 rids = i[:nqs]
                 rd = np.asarray(d, np.float64)[:nqs]
@@ -916,8 +933,7 @@ class ShardedEngine:
         self._queue_iters("sharded.device_full", select, its,
                           qpad // c, d_attrs.shape[0] // r,
                           d_attrs.shape[1], k)
-        # check: allow-host-sync
-        p, i, d = jax.device_get((p, i, d))
+        p, i, d = resilient_get((p, i, d), site="sharded.fetch")
         preds = p[:nq]
         rids = i[:nq]
         rd = np.asarray(d, np.float64)[:nq]

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
 from typing import List, Tuple
 
 import jax
@@ -36,6 +37,9 @@ from dmlp_tpu.obs import counters as obs_counters
 from dmlp_tpu.obs.trace import span as obs_span
 from dmlp_tpu.ops.topk import TopK, init_topk, make_block_step, streaming_topk
 from dmlp_tpu.ops.vote import majority_vote, report_order
+from dmlp_tpu.resilience import degrade as rs_degrade
+from dmlp_tpu.resilience import inject as rs_inject
+from dmlp_tpu.resilience import retry as rs_retry
 
 # Per-chunk distance-tile budget for the pipelined driver (bytes). The live
 # tile is (query_rows x chunk_rows) f32; chunk/query blocking keeps it under
@@ -90,8 +94,48 @@ def np_staging_dtype(staging: str):
 
 def stage_put(arr: np.ndarray, staging: str = "float32"):
     """Explicit (async) host->device put in the staging wire dtype —
-    the transfer-guard-proof spelling of ``jnp.asarray(arr, dtype)``."""
-    return jax.device_put(np.asarray(arr, np_staging_dtype(staging)))
+    the transfer-guard-proof spelling of ``jnp.asarray(arr, dtype)``.
+
+    The one staging chokepoint every chunked driver feeds through, so
+    it is a registered injection site (``single.stage_put``) and the
+    put carries the transient-retry wrapper: re-staging the same host
+    array is idempotent by construction. The fire rides INSIDE the
+    retried op so an injected transient is consumed by attempt 1 and
+    the retry's re-put lands."""
+    host = np.asarray(arr, np_staging_dtype(staging))
+
+    def _op():
+        rs_inject.fire("single.stage_put")
+        return jax.device_put(host)
+
+    return rs_retry.call_with_retry(_op, "single.stage_put")
+
+
+def resilient_get(values, site: str = "single.fetch"):
+    """Fenced device readback (the fetch IS the fence) with fault
+    injection + bounded transient retry — ``jax.device_get`` of
+    already-enqueued values is idempotent, so a flaky readback retries
+    without re-dispatching the solve. ``$DMLP_TPU_OP_TIMEOUT_S`` (off
+    by default — the readback IS the solve fence, so its normal
+    duration is the solve's) additionally bounds each attempt with a
+    worker-thread deadline; the resulting ``OperationTimeout``
+    classifies transient, so a slow-but-recoverable fetch retries and
+    the ``timeouts`` counter records it."""
+    deadline = float(os.environ.get("DMLP_TPU_OP_TIMEOUT_S", "0") or 0)
+
+    def _get():
+        rs_inject.fire(site)
+        return jax.device_get(values)  # check: allow-host-sync
+
+    def _op():
+        # The deadline is part of the resilience layer: with the
+        # DMLP_TPU_RESILIENCE=0 kill switch the wrapper must be a
+        # direct call (no worker thread, no unretried OperationTimeout).
+        if deadline > 0 and rs_retry.resilience_enabled():
+            return rs_retry.call_with_timeout(_get, deadline, site=site)
+        return _get()
+
+    return rs_retry.call_with_retry(_op, site)
 
 
 def plan_chunks(n: int, granule: int, target: int | None) -> Tuple[int, int, int]:
@@ -257,7 +301,7 @@ def flush_measured_iters(engine) -> None:
         try:
             obs_counters.record_measured_iters(  # check: allow-host-sync
                 site, int(jax.device_get(s)), shape)
-        except Exception:
+        except Exception:  # check: no-retry
             pass  # observability must never fail the solve
 
 
@@ -432,6 +476,11 @@ class SingleChipEngine:
         self.last_phase_ms: dict = {}
         self.last_hetk = None  # (bulk, outlier) counts when routing split
         self.last_mp_passes = 0  # multi-pass extraction pass count
+        # Degradation-ladder rung (resilience.degrade): "streaming"
+        # forces the chunk-fold driver (no extract-kernel dispatch);
+        # last_degrade_rung reports the rung the last run() settled on.
+        self._degrade_rung = "tuned"
+        self.last_degrade_rung = "tuned"
         self._mp_hazard = None   # its per-query loss flags (run() repairs)
         # (site, device iters-sum scalar, (qb, b, a, kc)) triples the
         # extract paths queue when a cost probe is installed; flushed to
@@ -588,6 +637,8 @@ class SingleChipEngine:
         if n == 0 or nq == 0:
             return None
 
+        rs_inject.fire("single.extract_solve", rung=self._degrade_rung,
+                       path="single")
         granule = cfg.resolve_granule("extract")
         t0 = _time.perf_counter()
         npad, nchunks, chunk_rows = plan_chunks(n, granule, cfg.data_block)
@@ -732,6 +783,8 @@ class SingleChipEngine:
                 "pass 1 and the resident passes 2+")
         interpret = not native_pallas_backend()
         self._last_select = "extract"
+        rs_inject.fire("single.extract_solve", rung=self._degrade_rung,
+                       path="multipass")
 
         t0 = _time.perf_counter()
         q_attrs = np.zeros((qpad, na), np.float32)
@@ -828,7 +881,7 @@ class SingleChipEngine:
                                jax.device_put(inp.labels), kcap=kcap)
         # One fence for everything: fd sequence (stall check), final
         # valid counts (shortfall check).
-        fetched = jax.device_get([valid] + fds)  # check: allow-host-sync
+        fetched = resilient_get([valid] + fds)
         valid_h, fd_h = fetched[0], fetched[1:]
         stalled = np.zeros(qpad, bool)
         for prev, cur in zip(fd_h, fd_h[1:]):
@@ -848,7 +901,10 @@ class SingleChipEngine:
             round_up(max(inp.params.num_data, 1), 8))
         if select == "sort":
             return self._solve_scan(inp)
-        if select == "extract":
+        # The "streaming" degradation rung (resilience.degrade) forbids
+        # extract-kernel dispatch: the chunk-fold driver below holds no
+        # running-list kernel state and its live tile is one slab.
+        if select == "extract" and self._degrade_rung != "streaming":
             out = self._solve_extract(inp)
             if out is not None:
                 return out
@@ -897,6 +953,8 @@ class SingleChipEngine:
         interpret = not native_pallas_backend()
         self._last_select = "extract"
         self.last_hetk = (int(bulk.size), int(outl.size))
+        rs_inject.fire("single.extract_solve", rung=self._degrade_rung,
+                       path="routed")
 
         qb_host = np.zeros((qpad_b, na), np.float32)
         qb_host[:len(bulk)] = inp.query_attrs[bulk]
@@ -961,13 +1019,17 @@ class SingleChipEngine:
         self._mp_hazard = None
         self.last_mp_passes = 0
         self._pending_iters = []
-        plan = self._plan_hetk(inp)
+        # Both routed and multipass paths dispatch the extraction
+        # kernel; the "streaming" rung skips straight to _solve, whose
+        # own gate lands on the chunk-fold driver.
+        streaming = self._degrade_rung == "streaming"
+        plan = None if streaming else self._plan_hetk(inp)
         if plan is not None:
             self.last_phase_ms = {}
             segs = self._solve_extract_routed(inp, plan)
             if segs is not None:
                 return segs
-        if allow_multipass:
+        if allow_multipass and not streaming:
             self.last_phase_ms = {}
             segs = self._solve_extract_multipass(inp)
             if segs is not None:
@@ -983,8 +1045,8 @@ class SingleChipEngine:
         nq = inp.params.num_queries
         # Explicit fenced readback (the result fetch IS the fence); the
         # sanitizer's transfer guard allows device_get, never implicit
-        # conversion.  # check: allow-host-sync
-        od, ol, oi = jax.device_get((out.dists, out.labels, out.ids))
+        # conversion.
+        od, ol, oi = resilient_get((out.dists, out.labels, out.ids))
         dists = np.asarray(od, np.float64)[:nq]
         labels = ol[:nq]
         ids = oi[:nq]
@@ -1006,7 +1068,10 @@ class SingleChipEngine:
         """
         kmax = int(inp.ks.max()) if inp.params.num_queries else 0
         with staging_for_k(self, kmax):
-            return self._run(inp)
+            # Degradation ladder (resilience.degrade): on device OOM —
+            # injected or real — the solve steps tuned -> heuristic ->
+            # streaming -> host-f64, every rung checksum-preserving.
+            return rs_degrade.run_ladder(self, inp, self._run)
 
     def _run(self, inp: KNNInput) -> List[QueryResult]:
         import time as _time
@@ -1042,7 +1107,7 @@ class SingleChipEngine:
             fetch = ([] if self.config.exact else [top.dists]) + [top.ids] \
                 + ([cols_dev] if cols_dev is not None else [])
             with obs_span("single.fetch", select=select, kcap=kcap):
-                fetched = list(jax.device_get(fetch))  # check: allow-host-sync
+                fetched = list(resilient_get(fetch))
             dists = None if self.config.exact \
                 else np.asarray(fetched.pop(0), np.float64)[:nq]
             ids = fetched.pop(0)[:nq]
@@ -1111,8 +1176,7 @@ class SingleChipEngine:
 
             p, i, d = _device_epilogue(top, jax.device_put(ks_pad),
                                        num_labels=num_labels)
-            # check: allow-host-sync
-            p, i, d = jax.device_get((p, i, d))
+            p, i, d = resilient_get((p, i, d))
             preds = p[:nq]
             rids = i[:nq]
             rd = np.asarray(d, np.float64)[:nq]

@@ -27,6 +27,28 @@ from typing import IO, List, Union
 import numpy as np
 
 
+class ParseError(ValueError):
+    """Malformed or truncated input, located by line and byte offset.
+
+    Subclasses ValueError (the historical raise type) so existing
+    handlers and tests keep working; the message keeps the reference's
+    exact phrasing ("Line is empty" / "Line is wrongly formatted") and
+    appends the location — a truncated pipe or corrupted payload names
+    WHERE the grammar broke instead of surfacing an uncaught
+    struct/index error from the array-filling loop."""
+
+    def __init__(self, message: str, line: int = None,
+                 byte_offset: int = None):
+        self.line = line
+        self.byte_offset = byte_offset
+        loc = []
+        if line is not None:
+            loc.append(f"line {line}")
+        if byte_offset is not None:
+            loc.append(f"byte offset {byte_offset}")
+        super().__init__(message + (f" ({', '.join(loc)})" if loc else ""))
+
+
 @dataclasses.dataclass(frozen=True)
 class Params:
     """Problem-size header (reference common.h:4-8)."""
@@ -98,8 +120,13 @@ def _strict_int(tok: str) -> int:
 def parse_params(line: str) -> Params:
     """Parse the header line (reference common.cpp:12-15)."""
     toks = line.split()
-    return Params(_strict_int(toks[0]), _strict_int(toks[1]),
-                  _strict_int(toks[2]))
+    try:
+        return Params(_strict_int(toks[0]), _strict_int(toks[1]),
+                      _strict_int(toks[2]))
+    except (IndexError, ValueError):
+        raise ParseError("malformed header line (want 'num_data "
+                         "num_queries num_attrs')", line=1,
+                         byte_offset=0) from None
 
 
 def parse_update(line: str) -> Update:
@@ -117,8 +144,33 @@ def parse_input(stream: Union[IO[str], IO[bytes]]) -> KNNInput:
     Large inputs route through the native C++ tokenizer
     (dmlp_tpu.io.native, bit-identical results) when it is buildable;
     anything else uses the pure-Python parser below.
+
+    Registered injection site ``io.parse`` (resilience.inject): a
+    ``corrupt`` fault truncates the payload before parsing, the grammar
+    raises :class:`ParseError`, and the pristine in-memory payload is
+    re-parsed — corruption detected at the parse boundary recovers with
+    byte-identical results (stdin is consumed, but the bytes are not).
     """
     data = stream.read()
+    from dmlp_tpu.resilience import inject as rs_inject
+    actions = rs_inject.fire("io.parse") or ()
+    if "corrupt" in actions:
+        try:
+            _parse_payload(rs_inject.corrupt_bytes(data))
+        except ParseError:
+            from dmlp_tpu.obs import trace as obs_trace
+            from dmlp_tpu.resilience import stats as rs_stats
+            rs_stats.record_retry("io.parse")
+            obs_trace.instant("resilience.retry", site="io.parse",
+                              attempt=1, error="ParseError")
+        # The pristine in-memory payload is authoritative either way:
+        # a corrupted payload's parse result is never returned, even
+        # if it somehow parsed (silently changed answers are the one
+        # unforgivable failure mode).
+    return _parse_payload(data)
+
+
+def _parse_payload(data: Union[str, bytes]) -> KNNInput:
     if len(data) >= _NATIVE_THRESHOLD_BYTES:
         from dmlp_tpu.io import native
         if native.native_available():
@@ -139,44 +191,80 @@ def parse_input_text(text: str) -> KNNInput:
     pipeline"); this parser is the pure-Python fallback for the native C++
     one in :mod:`dmlp_tpu.io.native`.
     """
-    lines = text.splitlines()
+    # Split on '\n' EXACTLY (not splitlines(), which also splits on
+    # \r, \x0b, \x85, ...): the grammar is '\n'-separated like the
+    # native cursor parser, and the incremental byte offsets below are
+    # only honest if every separator is one byte of real input — a
+    # stray \r rides inside its line (whitespace to the tokenizer) and
+    # is counted, not silently split on.
+    lines = text.split("\n")
+    if lines and lines[-1] == "":
+        lines.pop()   # trailing terminator, not an empty final line
     if not lines:
-        raise ValueError("empty input")
+        raise ParseError("empty input", byte_offset=0)
     params = parse_params(lines[0])
     nd, nq, na = params.num_data, params.num_queries, params.num_attrs
     if len(lines) < 1 + nd + nq:
-        raise ValueError(
+        raise ParseError(
             f"input has {len(lines) - 1} record lines, expected {nd + nq}"
-        )
+            " — truncated input?", line=len(lines),
+            byte_offset=len(text))
+
+    # Line-start byte offsets, tracked incrementally — exact, because
+    # the split above consumes exactly one '\n' per line.
+    off = len(lines[0]) + 1
 
     labels = np.empty(nd, dtype=np.int32)
     data_attrs = np.empty((nd, na), dtype=np.float64)
     for i in range(nd):
         line = lines[1 + i]
         if not line:
-            raise ValueError("Line is empty")  # common.cpp:101
+            raise ParseError("Line is empty", line=2 + i,  # common.cpp:101
+                             byte_offset=off)
         if "_" in line:
             # Python's float()/int() accept PEP 515 underscores ("1_0" ->
             # 10.0); the reference's unchecked stringstream extraction
             # silently misparses them instead (see _strict_int). Reject
             # loudly — matching the native C++ parser, not the reference's
             # silent-garbage behavior.
-            raise ValueError("Line is wrongly formatted")
+            raise ParseError("Line is wrongly formatted", line=2 + i,
+                             byte_offset=off)
         toks = line.split()
-        labels[i] = int(toks[0])
-        data_attrs[i] = [float(t) for t in toks[1 : 1 + na]]
+        try:
+            if len(toks) < 1 + na:
+                # A short row with exactly one attr token would
+                # otherwise numpy-broadcast across the whole row —
+                # silent misparse, the worst failure mode.
+                raise IndexError
+            labels[i] = int(toks[0])
+            data_attrs[i] = [float(t) for t in toks[1 : 1 + na]]
+        except (IndexError, ValueError):
+            # Short rows, non-numeric garbage — the uncaught-index-error
+            # class a corrupted stdin used to surface raw.
+            raise ParseError("Line is wrongly formatted", line=2 + i,
+                             byte_offset=off) from None
+        off += len(line) + 1
 
     ks = np.empty(nq, dtype=np.int32)
     query_attrs = np.empty((nq, na), dtype=np.float64)
     for i in range(nq):
         line = lines[1 + nd + i]
         if not line or line[0] != "Q":
-            raise ValueError("Line is wrongly formatted")  # common.cpp:114
+            raise ParseError("Line is wrongly formatted",  # common.cpp:114
+                             line=2 + nd + i, byte_offset=off)
         if "_" in line:
-            raise ValueError("Line is wrongly formatted")
+            raise ParseError("Line is wrongly formatted",
+                             line=2 + nd + i, byte_offset=off)
         toks = line[1:].split()
-        ks[i] = int(toks[0])
-        query_attrs[i] = [float(t) for t in toks[1 : 1 + na]]
+        try:
+            if len(toks) < 1 + na:
+                raise IndexError   # see the data-row short-row guard
+            ks[i] = int(toks[0])
+            query_attrs[i] = [float(t) for t in toks[1 : 1 + na]]
+        except (IndexError, ValueError):
+            raise ParseError("Line is wrongly formatted",
+                             line=2 + nd + i, byte_offset=off) from None
+        off += len(line) + 1
 
     return KNNInput(params, labels, data_attrs, ks, query_attrs)
 

@@ -21,7 +21,7 @@ from typing import Optional
 
 import numpy as np
 
-from dmlp_tpu.io.grammar import KNNInput, Params
+from dmlp_tpu.io.grammar import KNNInput, Params, ParseError
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -99,10 +99,10 @@ def parse_input_text_native(text) -> KNNInput:
     raw = text if isinstance(text, bytes) else text.encode("ascii")
     hdr = (ctypes.c_long * 3)()
     if lib.dmlp_parse_header(raw, len(raw), hdr) != 0:
-        raise ValueError("malformed header line")
+        raise ParseError("malformed header line", line=1, byte_offset=0)
     nd, nq, na = int(hdr[0]), int(hdr[1]), int(hdr[2])
     if nd < 0 or nq < 0 or na < 0:
-        raise ValueError("negative sizes in header")
+        raise ParseError("negative sizes in header", line=1, byte_offset=0)
 
     labels = np.empty(nd, np.int32)
     data_attrs = np.empty((nd, na), np.float64)
@@ -113,5 +113,17 @@ def parse_input_text_native(text) -> KNNInput:
                              data_attrs.reshape(-1), ks,
                              query_attrs.reshape(-1), errbuf, len(errbuf))
     if rc != 0:
-        raise ValueError(errbuf.value.decode("ascii") or f"parse error {rc}")
+        raise _located_error(errbuf.value.decode("ascii"), rc)
     return KNNInput(Params(nd, nq, na), labels, data_attrs, ks, query_attrs)
+
+
+def _located_error(msg: str, rc: int) -> ParseError:
+    """The C side reports '<message> (byte offset N)' (fastparse.cpp
+    set_err); lift the offset into the structured ParseError field so
+    Python callers need no string parsing. Unknown shapes (an old .so
+    from before offsets existed) degrade to an unlocated ParseError."""
+    import re
+    m = re.search(r"^(.*) \(byte offset (\d+)\)$", msg or "")
+    if m:
+        return ParseError(m.group(1), byte_offset=int(m.group(2)))
+    return ParseError(msg or f"parse error {rc}")

@@ -30,6 +30,8 @@ import numpy as np
 
 from dmlp_tpu.engine.finalize import boundary_hazard, staging_eps
 from dmlp_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS
+from dmlp_tpu.resilience import inject as rs_inject
+from dmlp_tpu.resilience import retry as rs_retry
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -508,8 +510,17 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
         None)."""
         nqs = len(ks_seg)
         kmax = int(ks_seg.max()) if nqs else 1
+
+        def _solve_op():
+            rs_inject.fire("dist.rank_solve", rank=jax.process_index())
+            return engine.solve_local_shards(ga, gl, gi, gq, kmax)
+
         with obs_span("dist.solve_local_shards", nq=nqs, kmax=kmax) as sp:
-            top = engine.solve_local_shards(ga, gl, gi, gq, kmax)
+            # Re-dispatch on the same placed global arrays is idempotent;
+            # a transient per-rank dispatch failure retries locally
+            # (collective-free per-shard solve) instead of failing the
+            # whole cluster.
+            top = rs_retry.call_with_retry(_solve_op, "dist.rank_solve")
             sp.fence(top.dists)
         # The fence above synchronized the per-shard solve: drain the
         # measured extract-iters queue now (scalar readback) so the
@@ -529,6 +540,12 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
             # tools/merge_traces.py recompute the analytic expectation
             # (obs.comms.host_allgather_candidates_traffic) and
             # reconcile the two per rank.
+            def _gather_op():
+                rs_inject.fire("dist.allgather", rank=jax.process_index())
+                return (multihost_utils.process_allgather(my_d),
+                        multihost_utils.process_allgather(my_l),
+                        multihost_utils.process_allgather(my_i))
+
             with obs_span("dist.allgather_candidates",
                           nbytes=int(my_d.nbytes + my_l.nbytes
                                      + my_i.nbytes),
@@ -539,9 +556,8 @@ def distributed_contract_run(path: str, engine, out=None, err=None,
                           itemsizes=[int(my_d.dtype.itemsize),
                                      int(my_l.dtype.itemsize),
                                      int(my_i.dtype.itemsize)]):
-                all_d = multihost_utils.process_allgather(my_d)
-                all_l = multihost_utils.process_allgather(my_l)
-                all_i = multihost_utils.process_allgather(my_i)
+                all_d, all_l, all_i = rs_retry.call_with_retry(
+                    _gather_op, "dist.allgather")
             my_d = all_d.min(axis=0)
             my_l = all_l.max(axis=0)
             my_i = all_i.max(axis=0)

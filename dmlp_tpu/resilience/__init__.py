@@ -1,0 +1,33 @@
+"""Resilience subsystem: deterministic fault injection, retry/backoff,
+graceful degradation, and cluster supervision.
+
+The contract is *byte-identical recovery*: every mechanism here either
+re-runs a pure re-runnable operation (retry), steps to an
+exactness-preserving alternative (the degradation ladder, whose last
+rung is the float64 oracle itself), or rolls state back to a checkpoint
+and replays (the train NaN guard) — so a faulted run's output checksums
+equal the fault-free run's, which ``tools/chaos_run.py`` /
+``make chaos-smoke`` proves under seeded fault schedules.
+
+Layout: :mod:`.inject` (seeded fault schedules + the named injection
+sites), :mod:`.retry` (bounded backoff + error classification),
+:mod:`.degrade` (the OOM ladder), :mod:`.supervise` (heartbeat/timeout
+rank supervision + degraded fallback), :mod:`.stats` (the counters the
+metrics summaries and RunRecords surface as their ``resilience``
+block).
+"""
+
+from dmlp_tpu.resilience.inject import (FaultSchedule, InjectedFault,
+                                        InjectedTransientError,
+                                        SimulatedResourceExhausted)
+from dmlp_tpu.resilience.retry import (DEFAULT_POLICY, OperationTimeout,
+                                       RetryPolicy, call_with_retry,
+                                       call_with_timeout, classify,
+                                       resilience_enabled)
+
+__all__ = [
+    "FaultSchedule", "InjectedFault", "InjectedTransientError",
+    "SimulatedResourceExhausted", "RetryPolicy", "DEFAULT_POLICY",
+    "OperationTimeout", "call_with_retry", "call_with_timeout",
+    "classify", "resilience_enabled",
+]

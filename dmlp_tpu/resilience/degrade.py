@@ -1,0 +1,90 @@
+"""Graceful-degradation ladder for the extract/solve path.
+
+On device memory exhaustion (simulated RESOURCE_EXHAUSTED from the
+fault injector, or a real XLA OOM — resilience.retry.classify treats
+them identically) the single-chip solve steps DOWN a ladder instead of
+crashing, and every rung preserves the contract checksums exactly:
+
+1. ``tuned``      — the normal path: extraction kernel with the
+                    autotuner's cached variant (dmlp_tpu.tune).
+2. ``heuristic``  — the extraction kernel with the heuristic variant
+                    (tune-cache lookups suppressed): a swept variant's
+                    larger tiles are the first allocation to give back;
+                    results are bit-identical by the PR 3 contract.
+3. ``streaming``  — the chunked multipass streaming fold
+                    (engine.single._solve_pipelined): no running-list
+                    kernel state, the live tile shrinks to one
+                    (query_block x chunk) slab.
+4. ``host``       — the float64 golden solve on the host
+                    (golden.fast.knn_golden_fast): zero device memory;
+                    it IS the oracle the contract diffs against, so
+                    byte-identity is by construction.
+
+Each step records a ``resilience.degrade`` trace event and a stats
+degradation entry, so the ledger and chaos harness can see recovery
+happen (and measure what it cost).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, List
+
+from dmlp_tpu.resilience import stats
+from dmlp_tpu.resilience.retry import classify, resilience_enabled
+
+RUNGS = ("tuned", "heuristic", "streaming", "host")
+
+
+@contextlib.contextmanager
+def _rung_context(engine, rung: str):
+    """Configure the engine for one rung. ``_degrade_rung`` is consulted
+    by engine.single._solve/_solve_segments (``streaming`` skips every
+    extract-kernel path); ``heuristic`` suppresses autotuner cache
+    lookups for the duration."""
+    prev = getattr(engine, "_degrade_rung", "tuned")
+    engine._degrade_rung = rung
+    try:
+        if rung == "heuristic":
+            from dmlp_tpu.tune import cache as tune_cache
+            with tune_cache.suppressed():
+                yield
+        else:
+            yield
+    finally:
+        engine._degrade_rung = prev
+
+
+def _host_fallback(inp) -> List:
+    """Rung 4: the float64 host oracle (exact by construction)."""
+    from dmlp_tpu.golden.fast import knn_golden_fast
+    from dmlp_tpu.obs.trace import span as obs_span
+    with obs_span("resilience.host_fallback",
+                  nq=inp.params.num_queries, n=inp.params.num_data):
+        return knn_golden_fast(inp)
+
+
+def run_ladder(engine, inp, solve: Callable):
+    """Run ``solve(inp)`` (normally ``engine._run``), stepping down the
+    ladder on each OOM-class failure; the last rung needs no device
+    memory at all. Non-OOM errors propagate unchanged — the ladder
+    trades capacity, it does not paper over bugs."""
+    if not resilience_enabled():
+        return solve(inp)
+    engine.last_degrade_rung = RUNGS[0]
+    for i, rung in enumerate(RUNGS):
+        try:
+            engine.last_degrade_rung = rung
+            if rung == "host":
+                return _host_fallback(inp)
+            with _rung_context(engine, rung):
+                return solve(inp)
+        except Exception as e:
+            if classify(e) != "oom" or i + 1 >= len(RUNGS):
+                raise
+            nxt = RUNGS[i + 1]
+            stats.record_degradation(rung, nxt)
+            from dmlp_tpu.obs import trace as obs_trace
+            obs_trace.instant("resilience.degrade", frm=rung, to=nxt,
+                              error=str(e)[:200])
+    raise AssertionError("unreachable: the host rung returns or raises")

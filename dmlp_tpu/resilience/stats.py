@@ -1,0 +1,100 @@
+"""Process-wide resilience accounting — the RunRecord/metrics feed.
+
+One module-level :class:`ResilienceStats` collects what the resilience
+layer actually did during a run (retries taken, degradation-ladder
+steps, faults the injection framework fired, train rollbacks,
+supervision restarts), mirroring the obs counters' install/collect
+shape: the engines and wrappers record unconditionally (cheap integer
+bumps), emitters snapshot once per run into the metrics summary /
+RunRecord ``resilience`` block, and the chaos harness asserts recovery
+was *visible*, not silent.
+
+Import-light by design (stdlib only): every resilience hook sits on a
+hot path that must cost nothing when nothing goes wrong.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List
+
+
+@dataclasses.dataclass
+class ResilienceStats:
+    """Counters for one process's resilience activity."""
+
+    retries: int = 0
+    rollbacks: int = 0
+    restarts: int = 0
+    timeouts: int = 0
+    faults_injected: int = 0
+    degradations: List[str] = dataclasses.field(default_factory=list)
+    retry_sites: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def any_activity(self) -> bool:
+        return bool(self.retries or self.rollbacks or self.restarts
+                    or self.timeouts or self.faults_injected
+                    or self.degradations)
+
+
+_lock = threading.Lock()
+_stats = ResilienceStats()
+
+
+def reset() -> None:
+    global _stats
+    with _lock:
+        _stats = ResilienceStats()
+
+
+def record_retry(site: str) -> None:
+    with _lock:
+        _stats.retries += 1
+        _stats.retry_sites[site] = _stats.retry_sites.get(site, 0) + 1
+
+
+def record_degradation(frm: str, to: str) -> None:
+    with _lock:
+        _stats.degradations.append(f"{frm}->{to}")
+
+
+def record_fault(site: str, kind: str) -> None:
+    with _lock:
+        _stats.faults_injected += 1
+
+
+def record_rollback() -> None:
+    with _lock:
+        _stats.rollbacks += 1
+
+
+def record_restart() -> None:
+    with _lock:
+        _stats.restarts += 1
+
+
+def record_timeout(site: str) -> None:
+    with _lock:
+        _stats.timeouts += 1
+
+
+def any_activity() -> bool:
+    with _lock:
+        return _stats.any_activity()
+
+
+def snapshot() -> dict:
+    """A JSON-ready copy of the counters — the ``resilience`` block the
+    metrics summary and RunRecords carry. Always includes every field
+    so consumers (the chaos harness) can assert zeros explicitly."""
+    with _lock:
+        return {
+            "retries": _stats.retries,
+            "rollbacks": _stats.rollbacks,
+            "restarts": _stats.restarts,
+            "timeouts": _stats.timeouts,
+            "faults_injected": _stats.faults_injected,
+            "degradations": list(_stats.degradations),
+            "retry_sites": dict(_stats.retry_sites),
+        }

@@ -1,0 +1,183 @@
+"""Cluster supervision: heartbeat + timeout detection of dead or hung
+ranks, bounded relaunch, and degraded single-process fallback.
+
+The reference's only hang protection is ``mpirun --timeout`` — kill
+everything and report nothing. The supervisor here is the launcher-side
+half of a real failure-handling story (PAPERS.md's large-cluster
+training systems treat this as a first-class subsystem):
+
+- every rank process writes a **heartbeat file** (``hb-rank<NN>``,
+  mtime refreshed by a daemon thread started when
+  ``$DMLP_TPU_HEARTBEAT`` names the file — dmlp_tpu.distributed does
+  this automatically);
+- the supervisor polls child liveness + heartbeat freshness under one
+  **cluster deadline**: a rank that exits nonzero, a heartbeat that
+  goes stale (crashed/frozen interpreter), or a blown deadline
+  (livelocked collective — heartbeat threads keep beating through
+  those, which is exactly why the deadline exists too) fails the
+  launch;
+- a failed launch kills the whole cluster and **relaunches** (bounded;
+  each restart is recorded);
+- exhausted restarts fall back to the caller's **degraded
+  single-process solve** — same contract checksums, no mesh. The
+  degradation is recorded, never silent.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from dmlp_tpu.resilience import stats
+
+#: env var naming the heartbeat file a rank process must keep fresh
+HEARTBEAT_ENV = "DMLP_TPU_HEARTBEAT"
+
+
+def heartbeat_file(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"hb-rank{rank:02d}")
+
+
+def start_heartbeat(path: str, interval_s: float = 0.5) -> threading.Event:
+    """Start the daemon heartbeat thread; returns its stop event.
+    Detects crashed or frozen interpreters — a livelocked C++
+    collective releases the GIL and beats on, which the supervisor's
+    cluster deadline covers instead."""
+    stop = threading.Event()
+
+    def _beat():
+        while not stop.is_set():
+            try:
+                with open(path, "a"):
+                    os.utime(path, None)
+            except OSError:
+                pass  # check: no-retry — a beat miss only ages the file
+            stop.wait(interval_s)
+
+    threading.Thread(target=_beat, daemon=True,
+                     name="resilience-heartbeat").start()
+    return stop
+
+
+def maybe_start_heartbeat_from_env() -> Optional[threading.Event]:
+    """Start the heartbeat when the supervisor asked for one
+    ($DMLP_TPU_HEARTBEAT) — called by rank entry points."""
+    path = os.environ.get(HEARTBEAT_ENV)
+    return start_heartbeat(path) if path else None
+
+
+class ClusterFailure(RuntimeError):
+    """Every supervised launch failed and no fallback was provided."""
+
+    def __init__(self, report: dict):
+        super().__init__(f"supervised cluster failed: {report}")
+        self.report = report
+
+
+def _kill_all(procs: List[subprocess.Popen]) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass  # check: no-retry — already killed; nothing left to do
+
+
+def run_supervised(make_cluster: Callable[[int], List[List[str]]],
+                   workdir: str, *, env: Optional[dict] = None,
+                   cluster_timeout_s: float = 300.0,
+                   hb_stale_s: float = 15.0, poll_s: float = 0.1,
+                   max_launches: int = 2,
+                   fallback: Optional[Callable[[], Tuple[bytes, bytes]]]
+                   = None,
+                   clock: Callable = time.monotonic,
+                   ) -> Tuple[bytes, bytes, dict]:
+    """Launch-and-watch loop. ``make_cluster(attempt)`` returns one argv
+    per rank (fresh coordinator port per attempt); rank files land under
+    ``workdir``. Returns (rank-0 stdout bytes, rank-0 stderr bytes,
+    report). On total failure, runs ``fallback()`` — the degraded
+    single-process solve — or raises :class:`ClusterFailure`."""
+    os.makedirs(workdir, exist_ok=True)
+    report: dict = {"launches": [], "fallback": False}
+    base_env = dict(env if env is not None else os.environ)
+
+    for attempt in range(max(max_launches, 1)):
+        argvs = make_cluster(attempt)
+        hb_dir = os.path.join(workdir, f"hb-attempt{attempt}")
+        os.makedirs(hb_dir, exist_ok=True)
+        outs, errs, procs = [], [], []
+        for rank, argv in enumerate(argvs):
+            e = dict(base_env)
+            e[HEARTBEAT_ENV] = heartbeat_file(hb_dir, rank)
+            out_f = open(os.path.join(
+                workdir, f"rank{rank}.a{attempt}.out"), "wb")
+            err_f = open(os.path.join(
+                workdir, f"rank{rank}.a{attempt}.err"), "wb")
+            outs.append(out_f)
+            errs.append(err_f)
+            procs.append(subprocess.Popen(argv, stdout=out_f, stderr=err_f,
+                                          env=e))
+        deadline = clock() + cluster_timeout_s
+        failure = None
+        while failure is None:
+            rcs = [p.poll() for p in procs]
+            if all(rc is not None for rc in rcs):
+                bad = [i for i, rc in enumerate(rcs) if rc != 0]
+                failure = (f"rank(s) {bad} exited nonzero {rcs}"
+                           if bad else "")
+                break
+            dead = [i for i, rc in enumerate(rcs)
+                    if rc is not None and rc != 0]
+            if dead:
+                failure = f"rank(s) {dead} died mid-run (rc {rcs})"
+                break
+            if clock() > deadline:
+                failure = (f"cluster deadline {cluster_timeout_s:.3g}s "
+                           "exceeded (hung rank or livelocked "
+                           "collective)")
+                break
+            now = time.time()
+            stale = [i for i in range(len(procs))
+                     if rcs[i] is None
+                     and os.path.exists(heartbeat_file(hb_dir, i))
+                     and now - os.path.getmtime(
+                         heartbeat_file(hb_dir, i)) > hb_stale_s]
+            if stale:
+                failure = (f"heartbeat stale (> {hb_stale_s:.3g}s) for "
+                           f"rank(s) {stale}")
+                break
+            time.sleep(poll_s)
+        _kill_all(procs)
+        for f in outs + errs:
+            f.close()
+        report["launches"].append({"attempt": attempt,
+                                   "ok": failure == "",
+                                   **({"failure": failure} if failure
+                                      else {})})
+        if failure == "":
+            with open(os.path.join(workdir, f"rank0.a{attempt}.out"),
+                      "rb") as f:
+                out_b = f.read()
+            with open(os.path.join(workdir, f"rank0.a{attempt}.err"),
+                      "rb") as f:
+                err_b = f.read()
+            return out_b, err_b, report
+        if attempt + 1 < max_launches:
+            stats.record_restart()
+            from dmlp_tpu.obs import trace as obs_trace
+            obs_trace.instant("resilience.restart", attempt=attempt,
+                              reason=failure)
+
+    if fallback is None:
+        raise ClusterFailure(report)
+    stats.record_degradation("cluster", "single-process")
+    from dmlp_tpu.obs import trace as obs_trace
+    obs_trace.instant("resilience.fallback", to="single-process")
+    report["fallback"] = True
+    out_b, err_b = fallback()
+    return out_b, err_b, report

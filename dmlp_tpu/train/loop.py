@@ -30,6 +30,9 @@ import jax
 import jax.numpy as jnp
 
 from dmlp_tpu.obs.trace import span as obs_span
+from dmlp_tpu.resilience import inject as rs_inject
+from dmlp_tpu.resilience import retry as rs_retry
+from dmlp_tpu.resilience import stats as rs_stats
 from dmlp_tpu.train import checkpoint as ckpt_lib
 from dmlp_tpu.train.data import teacher_batches
 from dmlp_tpu.train.metrics import throughput_metrics
@@ -230,7 +233,8 @@ def train(steps: int = 100, batch: int = 1024,
           n_micro: int = 4, n_experts: int = 8,
           moe_dispatch: str = "dense", capacity_factor: float = 1.0,
           pp_schedule: str = "gpipe", n_virtual: int = 2,
-          sanitize: bool = False):
+          sanitize: bool = False, nan_guard: bool = False,
+          lr_backoff: float = 0.5, max_rollbacks: int = 3):
     optimizer = make_optimizer(optimizer_name, lr)
     mesh, state, step_fn, (d_in, n_classes), shardings = _build_parallel(
         parallelism, mesh_shape, tuple(dims), optimizer, compute_dtype,
@@ -244,8 +248,34 @@ def train(steps: int = 100, batch: int = 1024,
         start_step = int(jax.device_get(state["step"]))
 
     from dmlp_tpu.train.data import prefetch_to_device
-    data = prefetch_to_device(
-        teacher_batches(d_in, n_classes, batch, seed=seed + 1), shardings)
+
+    def make_data(skip: int):
+        """The seed-keyed batch stream positioned ``skip`` batches past
+        this run's start — a NaN-guard rollback re-creates it so the
+        replayed steps consume EXACTLY the batches the first pass did
+        (step-identical recovery; proven in tests/test_train.py)."""
+        it = teacher_batches(d_in, n_classes, batch, seed=seed + 1)
+        for _ in range(skip):
+            next(it)
+        return prefetch_to_device(it, shardings)
+
+    data = make_data(0)
+
+    # LR-backoff escalation rebuilds the step with a decayed LR when the
+    # SAME step produces a non-finite loss twice (deterministic replay
+    # would otherwise diverge identically forever). Optimizer-state
+    # structure is LR-independent (optax), so the live moments carry
+    # over. dp_tp only — the pipeline/MoE step factories don't take a
+    # bare optimizer swap; rollback still works there, escalation raises.
+    def _rebuild_step_dp_tp(new_lr: float):
+        opt2 = make_optimizer(optimizer_name, new_lr)
+        cdtype = jnp.bfloat16 if compute_dtype == "bfloat16" else None
+        if resolve_offload_level(offload) != "none":
+            from dmlp_tpu.train.step import make_offload_train_step
+            return make_offload_train_step(opt2, cdtype, state)
+        return make_train_step(opt2, cdtype)
+
+    rebuild_step = _rebuild_step_dp_tp if parallelism == "dp_tp" else None
 
     # Analytic collective-traffic accounting for this run's mesh
     # (obs.comms): the grad psum over dp, plus the MoE all-to-all when
@@ -267,15 +297,95 @@ def train(steps: int = 100, batch: int = 1024,
     def san():  # fresh context per step: @contextmanager cms are one-shot
         return maybe_sanitized(train=True, force=sanitize)
 
+    # Every step must be recoverable: a non-finite loss BEFORE the
+    # first periodic checkpoint would otherwise have nothing to roll
+    # back to (ckpt_every can exceed the divergence step) — seed the
+    # dir with the start state, which save-at-end would overwrite only
+    # at the same-or-later step anyway.
+    if nan_guard and checkpoint_dir \
+            and ckpt_lib.latest_step(checkpoint_dir) is None:
+        ckpt_lib.save_checkpoint(checkpoint_dir, state, step=start_step)
+
     last = {}
     t_window = time.perf_counter()
     window_steps = 0
-    for i in range(start_step, start_step + steps):
+    cur_lr = lr
+    total_rollbacks = 0
+    rollbacks_at: dict = {}   # step index -> rollback count at that step
+    end = start_step + steps
+    i = start_step
+    while i < end:
         xd, yd = next(data)
-        with obs_span("train.step"), san():
-            state, m = step_fn(state, xd, yd)
+
+        def _step_op():
+            # The injection fire rides INSIDE the retried op: a
+            # transient fault at this site is consumed on attempt 1 and
+            # the retry's re-dispatch (same state/batch — pure) lands.
+            acts = rs_inject.fire("train.step", step=i) or ()
+            with obs_span("train.step"), san():
+                s2, m2 = step_fn(state, xd, yd)
+            return acts, s2, m2
+
+        actions, new_state, m = rs_retry.call_with_retry(
+            _step_op, "train.step")
+
+        if nan_guard:
+            # Per-step loss readback (opt-in: --nan-guard; the default
+            # loop keeps its async log_every cadence). An injected
+            # "nan" action poisons the detector input — the rollback
+            # machinery is driven without corrupting any real state.
+            import math
+            loss_val = (float("nan") if "nan" in actions
+                        else float(jax.device_get(m["loss"])))
+            if not math.isfinite(loss_val):
+                if not checkpoint_dir:
+                    raise RuntimeError(
+                        f"non-finite loss at step {i + 1} and nowhere "
+                        "to roll back to — the NaN guard needs "
+                        "checkpoint_dir/--checkpoint-dir")
+                total_rollbacks += 1
+                rs_stats.record_rollback()
+                if total_rollbacks > max_rollbacks:
+                    raise RuntimeError(
+                        f"non-finite loss persisted through "
+                        f"{max_rollbacks} rollback(s) — giving up at "
+                        f"step {i + 1}")
+                seen = rollbacks_at.get(i, 0)
+                rollbacks_at[i] = seen + 1
+                if seen >= 1:
+                    # Same step diverged twice: replay alone cannot fix
+                    # a deterministic divergence — decay the LR.
+                    if rebuild_step is None:
+                        raise RuntimeError(
+                            f"step {i + 1} diverged twice and LR "
+                            f"backoff is unsupported for parallelism="
+                            f"{parallelism} (dp_tp only)")
+                    cur_lr *= lr_backoff
+                    step_fn = rebuild_step(cur_lr)
+                faulted_at = i
+                state = ckpt_lib.restore_checkpoint(checkpoint_dir, state)
+                i = int(jax.device_get(state["step"]))
+                if i > faulted_at:
+                    raise RuntimeError(
+                        f"latest checkpoint is step {i}, AHEAD of the "
+                        f"faulted step {faulted_at} — rolling back would "
+                        f"jump forward (stale checkpoint_dir "
+                        f"{checkpoint_dir!r} from an earlier run?)")
+                if i < start_step:
+                    raise RuntimeError(
+                        f"checkpoint step {i} precedes this run's data "
+                        f"stream start {start_step} — cannot replay")
+                from dmlp_tpu.obs import trace as obs_trace
+                obs_trace.instant("resilience.rollback", to_step=i,
+                                  lr=cur_lr)
+                data = make_data(i - start_step)
+                t_window = time.perf_counter()
+                window_steps = 0
+                continue
+
+        state = new_state
         window_steps += 1
-        if (i + 1) % log_every == 0 or i + 1 == start_step + steps:
+        if (i + 1) % log_every == 0 or i + 1 == end:
             with obs_span("train.log_window", step=i + 1) as sp:
                 m = jax.device_get(m)
                 sp.fence(state["params"])
@@ -290,9 +400,9 @@ def train(steps: int = 100, batch: int = 1024,
         if checkpoint_dir and (i + 1) % ckpt_every == 0:
             with obs_span("train.checkpoint", step=i + 1):
                 ckpt_lib.save_checkpoint(checkpoint_dir, state, step=i + 1)
+        i += 1
     if checkpoint_dir:
-        ckpt_lib.save_checkpoint(checkpoint_dir, state,
-                                 step=start_step + steps)
+        ckpt_lib.save_checkpoint(checkpoint_dir, state, step=end)
     return state, last
 
 
@@ -347,6 +457,23 @@ def _train_comms(state, mesh, parallelism: str, dims, batch: int,
                                          pipeline=pipeline,
                                          moe_dense=moe_dense)
     return obs_comms.summarize(traffic) if traffic else None
+
+
+def _params_checksum(state) -> str:
+    """sha256 over the (deterministically ordered) param leaves' bytes —
+    the step-identical-recovery fingerprint in train RunRecords."""
+    import hashlib
+
+    import numpy as _np
+
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_leaves(state["params"])
+    for leaf in jax.device_get(leaves):
+        a = _np.asarray(leaf)
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
 
 
 def main(argv=None) -> int:
@@ -410,6 +537,16 @@ def main(argv=None) -> int:
                         "'disallow') + jax.checking_leaks + "
                         "jax.debug_nans (dmlp_tpu.check.sanitize); "
                         "$DMLP_TPU_SANITIZE=1 enables it too")
+    p.add_argument("--nan-guard", action="store_true",
+                   help="per-step non-finite-loss guard: on NaN/inf "
+                        "loss, restore the latest checkpoint, replay "
+                        "the stream step-identically, and decay the LR "
+                        "(x0.5) if the same step diverges twice "
+                        "(needs --checkpoint-dir)")
+    p.add_argument("--faults", metavar="FILE", default=None,
+                   help="deterministic fault-injection schedule (JSON; "
+                        "dmlp_tpu.resilience.inject); $DMLP_TPU_FAULTS "
+                        "sets it too")
     p.add_argument("--offload", nargs="?", const="all", default="none",
                    choices=["none", "params", "all"],
                    help="host-DRAM offload level: 'params' keeps moments "
@@ -425,11 +562,14 @@ def main(argv=None) -> int:
     if args.trace:
         from dmlp_tpu.obs import trace as obs_trace
         tracer = obs_trace.install(obs_trace.Tracer())
+    rs_stats.reset()
+    schedule = rs_inject.install_from_env(args.faults)
+    final_state = None
     try:
         mlog = (MetricsLogger(path=args.metrics_file)
                 if args.metrics_file else MetricsLogger())
         with mlog as metrics:
-            _, last = train(
+            final_state, last = train(
                 steps=args.steps, batch=args.batch,
                 dims=tuple(int(d) for d in args.dims.split(",")),
                 mesh_shape=mesh_shape, optimizer_name=args.optimizer,
@@ -443,8 +583,11 @@ def main(argv=None) -> int:
                 capacity_factor=args.capacity_factor,
                 pp_schedule=args.pp_schedule,
                 n_virtual=args.virtual_stages,
-                sanitize=args.sanitize)
+                sanitize=args.sanitize, nan_guard=args.nan_guard)
     finally:
+        if schedule is not None:
+            rs_inject.write_log_if_requested()
+            rs_inject.uninstall()
         if tracer is not None:
             from dmlp_tpu.obs import trace as obs_trace
             tracer.write(args.trace)
@@ -457,6 +600,14 @@ def main(argv=None) -> int:
             artifacts["trace"] = args.trace
         if args.metrics_file:
             artifacts["metrics"] = args.metrics_file
+        rec_metrics = dict(last)
+        if final_state is not None:
+            # Bitwise state fingerprint: the chaos harness proves a
+            # NaN-faulted run resumed step-identically by comparing
+            # this against the fault-free run's checksum.
+            rec_metrics["params_checksum"] = _params_checksum(final_state)
+        if rs_stats.any_activity() or schedule is not None:
+            rec_metrics["resilience"] = rs_stats.snapshot()
         RunRecord(
             kind="train", tool="dmlp_tpu.train",
             config={"parallelism": args.parallelism,
@@ -467,8 +618,9 @@ def main(argv=None) -> int:
                     "compute_dtype": args.compute_dtype,
                     "offload": args.offload,
                     "moe_dispatch": args.moe_dispatch,
-                    "pp_schedule": args.pp_schedule},
-            metrics=dict(last), artifacts=artifacts,
+                    "pp_schedule": args.pp_schedule,
+                    "nan_guard": args.nan_guard},
+            metrics=rec_metrics, artifacts=artifacts,
             device=current_device(),
             round=round_from_name(args.record)).write(args.record)
     print(f"final: {last}")

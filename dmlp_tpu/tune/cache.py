@@ -30,6 +30,7 @@ Design constraints, in order:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -219,6 +220,23 @@ class VariantCache:
 _memo: Dict[str, Optional[VariantCache]] = {}
 _device_kind_memo: Dict[str, str] = {}
 
+#: >0 = lookups disabled (the degradation ladder's "heuristic" rung:
+#: after a device OOM the first thing to give back is a swept variant's
+#: larger tiles — resilience.degrade enters this context for the
+#: retried solve, and resolution falls to the bit-identical heuristic).
+_suppress_depth = 0
+
+
+@contextlib.contextmanager
+def suppressed():
+    """Context manager disabling cache lookups for its duration."""
+    global _suppress_depth
+    _suppress_depth += 1
+    try:
+        yield
+    finally:
+        _suppress_depth -= 1
+
 
 def clear_lookup_memo() -> None:
     """Drop the per-process cache/device memo (tests, or after a sweep
@@ -255,7 +273,7 @@ def lookup_variant(kc: int, b: int, a: Optional[int] = None,
     different device kind, the matched entry is corrupt, or its variant
     cannot tile this ``b`` (alignment rejection) — the caller then uses
     the deterministic heuristic."""
-    if a is None:
+    if _suppress_depth or a is None:
         return None
     path = path or cache_path()
     if path not in _memo:

@@ -127,9 +127,13 @@ inline bool parse_double(Cursor& c, double* out) {
     return true;
 }
 
-void set_err(char* errbuf, size_t errlen, const char* msg) {
+// Error messages carry the cursor's byte offset so the Python side
+// (io.native) can surface a located ParseError — a truncated pipe or a
+// corrupted payload should name WHERE the grammar broke, not just that
+// it did.
+void set_err(char* errbuf, size_t errlen, const char* msg, long off) {
     if (errbuf && errlen) {
-        snprintf(errbuf, errlen, "%s", msg);
+        snprintf(errbuf, errlen, "%s (byte offset %ld)", msg, off);
     }
 }
 
@@ -159,30 +163,33 @@ int dmlp_parse_body(const char* text, size_t len, long num_data,
                     char* errbuf, size_t errlen) {
     Cursor c{text, text + len};
     if (!next_line(c) && num_data + num_queries > 0) {  // skip header
-        set_err(errbuf, errlen, "truncated input");
+        set_err(errbuf, errlen, "truncated input", (long)(c.p - text));
         return 1;
     }
     for (long i = 0; i < num_data; ++i) {
         skip_spaces(c);
         if (at_eol(c)) {
-            set_err(errbuf, errlen, "Line is empty");  // common.cpp:101
+            set_err(errbuf, errlen, "Line is empty",
+                    (long)(c.p - text));  // common.cpp:101
             return 2;
         }
         long label;
         if (!parse_long(c, &label)) {
-            set_err(errbuf, errlen, "Line is wrongly formatted");
+            set_err(errbuf, errlen, "Line is wrongly formatted",
+                        (long)(c.p - text));
             return 3;
         }
         labels[i] = static_cast<int32_t>(label);
         double* row = data_attrs + i * num_attrs;
         for (long a = 0; a < num_attrs; ++a) {
             if (!parse_double(c, &row[a])) {
-                set_err(errbuf, errlen, "Line is wrongly formatted");
+                set_err(errbuf, errlen, "Line is wrongly formatted",
+                        (long)(c.p - text));
                 return 3;
             }
         }
         if (!next_line(c) && i + 1 < num_data + num_queries) {
-            set_err(errbuf, errlen, "truncated input");
+            set_err(errbuf, errlen, "truncated input", (long)(c.p - text));
             return 1;
         }
     }
@@ -191,20 +198,23 @@ int dmlp_parse_body(const char* text, size_t len, long num_data,
         // whitespace, exactly like the Python parser's line[0] != 'Q'
         // check (mirroring common.cpp:108-114).
         if (at_eol(c) || *c.p != 'Q') {
-            set_err(errbuf, errlen, "Line is wrongly formatted");
+            set_err(errbuf, errlen, "Line is wrongly formatted",
+                        (long)(c.p - text));
             return 4;
         }
         ++c.p;
         long k;
         if (!parse_long(c, &k)) {
-            set_err(errbuf, errlen, "Line is wrongly formatted");
+            set_err(errbuf, errlen, "Line is wrongly formatted",
+                        (long)(c.p - text));
             return 4;
         }
         ks[i] = static_cast<int32_t>(k);
         double* row = query_attrs + i * num_attrs;
         for (long a = 0; a < num_attrs; ++a) {
             if (!parse_double(c, &row[a])) {
-                set_err(errbuf, errlen, "Line is wrongly formatted");
+                set_err(errbuf, errlen, "Line is wrongly formatted",
+                        (long)(c.p - text));
                 return 4;
             }
         }

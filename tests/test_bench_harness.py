@@ -254,3 +254,29 @@ def test_oracle_capture_kit_diff_roundtrip(tmp_path):
     r = subprocess.run([sys.executable, tool, str(mpath), "--configs", "1"],
                        capture_output=True, text=True, env=env)
     assert r.returncode == 1 and "INPUT MISMATCH" in r.stdout
+
+
+def test_run_config_timeout_records_marker_not_gate(tiny_cfg, tmp_path):
+    """Resilience satellite: a hung config documents itself with the
+    explicit `timed_out` marker (markers never gate, PR 5 convention)
+    and the bench run's verdict ignores it."""
+    buf = io.StringIO()
+    res = run_config(1, base_dir=str(tmp_path), out=buf, timeout_s=0.01,
+                     env=_scrubbed_env())
+    assert res.get("timed_out") is True
+    assert res.get("timeout") is True          # legacy spelling kept
+    # the main() gate treats timed_out as non-gating:
+    assert res["checksums_match"] or res.get("timed_out", False)
+
+
+def test_per_config_timeout_override(tiny_cfg, tmp_path, monkeypatch):
+    """BenchConfig.timeout_s beats the harness-wide --timeout."""
+    import dataclasses
+
+    from dmlp_tpu.bench import configs as bench_configs
+    cfg = dataclasses.replace(tiny_cfg, timeout_s=0.01)
+    monkeypatch.setitem(bench_configs.BENCH_CONFIGS, 1, cfg)
+    buf = io.StringIO()
+    res = run_config(1, base_dir=str(tmp_path), out=buf, timeout_s=600.0,
+                     env=_scrubbed_env())
+    assert res.get("timed_out") is True        # 600s harness limit unused

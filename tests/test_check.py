@@ -438,6 +438,87 @@ class TestR4Compat:
 
 
 # ---------------------------------------------------------------------------
+# R5 — resilience-path silent swallowing
+# ---------------------------------------------------------------------------
+
+
+class TestR5Resilient:
+    def test_r501_broad_swallow_in_resilience_module(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/resilience/x.py", """
+            def f(op):
+                try:
+                    return op()
+                except Exception:
+                    return None
+        """)
+        assert "R501" in rules_of(run_check(tmp_path, ["R5"]))
+
+    def test_r501_importer_of_resilience_in_scope(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from dmlp_tpu.resilience import retry as rs_retry
+            def f(op):
+                try:
+                    return rs_retry.call_with_retry(op, "s")
+                except Exception:
+                    return None
+        """)
+        assert "R501" in rules_of(run_check(tmp_path, ["R5"]))
+
+    def test_r501_reraise_is_compliant(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/resilience/x.py", """
+            def f(op):
+                try:
+                    return op()
+                except Exception as e:
+                    raise RuntimeError("wrapped") from e
+        """)
+        assert run_check(tmp_path, ["R5"]) == []
+
+    def test_r501_annotation_silences(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/resilience/x.py", """
+            def f(op):
+                try:
+                    return op()
+                except Exception:  # check: no-retry
+                    return None
+        """)
+        assert run_check(tmp_path, ["R5"]) == []
+
+    def test_r501_narrow_catch_is_fine(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/resilience/x.py", """
+            def f(op):
+                try:
+                    return op()
+                except ValueError:
+                    return None
+        """)
+        assert run_check(tmp_path, ["R5"]) == []
+
+    def test_r501_nested_def_raise_does_not_count(self, tmp_path):
+        # Defining a raiser inside the handler is not raising: the
+        # swallow still needs a re-raise or the annotation.
+        write(tmp_path, "dmlp_tpu/resilience/x.py", """
+            def f(op):
+                try:
+                    return op()
+                except Exception:
+                    def _report():
+                        raise RuntimeError("later")
+                    return None
+        """)
+        assert "R501" in rules_of(run_check(tmp_path, ["R5"]))
+
+    def test_module_without_resilience_import_out_of_scope(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/obs/x.py", """
+            def f(op):
+                try:
+                    return op()
+                except Exception:
+                    return None
+        """)
+        assert run_check(tmp_path, ["R5"]) == []
+
+# ---------------------------------------------------------------------------
 # R0 — hygiene (the ruff-subset fallback behind make lint)
 # ---------------------------------------------------------------------------
 

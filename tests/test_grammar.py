@@ -63,3 +63,68 @@ def test_header_underscore_rejected():
     from dmlp_tpu.io.grammar import parse_params
     with pytest.raises(ValueError):
         parse_params("1_0 1 1")
+
+
+# -- located ParseError (resilience satellite): truncated or corrupt stdin
+# must name WHERE the grammar broke, never surface a raw struct/index error.
+
+def test_parse_error_is_valueerror_with_location():
+    from dmlp_tpu.io.grammar import ParseError
+    e = ParseError("Line is wrongly formatted", line=3, byte_offset=17)
+    assert isinstance(e, ValueError)           # historical raise type
+    assert e.line == 3 and e.byte_offset == 17
+    assert "line 3" in str(e) and "byte offset 17" in str(e)
+
+
+def test_short_data_row_raises_parse_error_not_index_error():
+    from dmlp_tpu.io.grammar import ParseError
+    bad = "2 0 3\n0 1.0 2.0 3.0\n1 4.0\n"       # second row short
+    with pytest.raises(ParseError, match="wrongly formatted") as ei:
+        parse_input_text(bad)
+    assert ei.value.line == 3
+    assert ei.value.byte_offset == bad.index("1 4.0")
+
+
+def test_garbage_token_locates_the_bad_line():
+    from dmlp_tpu.io.grammar import ParseError
+    bad = "2 1 2\n0 1.0 2.0\n1 3.0 oops\nQ 1 0.0 0.0\n"
+    with pytest.raises(ParseError) as ei:
+        parse_input_text(bad)
+    assert ei.value.line == 3
+    assert ei.value.byte_offset == bad.index("1 3.0")
+
+
+def test_short_query_row_locates():
+    from dmlp_tpu.io.grammar import ParseError
+    bad = "1 1 2\n0 1.0 2.0\nQ 5\n"
+    with pytest.raises(ParseError) as ei:
+        parse_input_text(bad)
+    assert ei.value.line == 3
+    assert ei.value.byte_offset == bad.index("Q 5")
+
+
+def test_malformed_header_raises_parse_error():
+    from dmlp_tpu.io.grammar import ParseError
+    for bad in ("not numbers at all\n", "3\n", ""):
+        with pytest.raises(ParseError):
+            parse_input_text(bad)
+
+
+def test_truncated_input_reports_tail_offset():
+    from dmlp_tpu.io.grammar import ParseError
+    text = "5 5 2\n0 1.0 2.0\n"
+    with pytest.raises(ParseError, match="truncated") as ei:
+        parse_input_text(text)
+    assert ei.value.byte_offset == len(text)
+
+
+def test_crlf_input_offsets_are_exact():
+    """Offsets come from '\n'-exact splitting: a \r\n payload keeps its
+    \r inside the line (whitespace to the tokenizer), so the reported
+    byte offset points at the real line start."""
+    from dmlp_tpu.io.grammar import ParseError
+    bad = "2 0 2\r\n0 1.0 2.0\r\n1 oops 3.0\r\n"
+    with pytest.raises(ParseError) as ei:
+        parse_input_text(bad)
+    assert ei.value.line == 3
+    assert ei.value.byte_offset == bad.index("1 oops")

@@ -150,3 +150,24 @@ def test_edge_tokens_agree(tok):
     assert py_ok == nat_ok, tok
     if py_ok:
         assert want.data_attrs[0, 0] == got.data_attrs[0, 0]
+
+
+def test_native_error_carries_byte_offset():
+    """The C side stamps '(byte offset N)' (fastparse.cpp set_err);
+    io.native lifts it into the structured ParseError field."""
+    from dmlp_tpu.io.grammar import ParseError
+    bad = "1 1 2\n0 1.0 2.0\nX 1 1.0 2.0\n"
+    with pytest.raises(ParseError) as ei:
+        native.parse_input_text_native(bad)
+    assert ei.value.byte_offset == bad.index("X 1")
+
+
+def test_located_error_degrades_on_old_so_message():
+    """An old .so without offsets must still yield a ParseError."""
+    from dmlp_tpu.io.grammar import ParseError
+    from dmlp_tpu.io.native import _located_error
+    e = _located_error("Line is empty", 2)
+    assert isinstance(e, ParseError) and e.byte_offset is None
+    e2 = _located_error("Line is empty (byte offset 42)", 2)
+    assert e2.byte_offset == 42
+    assert _located_error("", 3).args[0] == "parse error 3"

@@ -128,8 +128,46 @@ def test_merge_aligns_clock_sync_and_rebases(tmp_path):
         assert all(b >= a for a, b in zip(seq, seq[1:]))
 
 
-def test_merge_fails_on_missing_rank(tmp_path):
-    _write_rank(tmp_path, 0, 2)   # rank 1 of 2 never wrote its file
+def test_merge_marks_missing_rank(tmp_path):
+    # Rank 1 of 2 never wrote its file (crashed/never started): the
+    # merge proceeds over the surviving rank with the explicit
+    # rank_trace_missing marker instead of refusing — the missing rank
+    # IS the failure being diagnosed, and the surviving trace is the
+    # evidence.
+    _write_rank(tmp_path, 0, 2)
+    merge_traces = _load_tool("merge_traces")
+    doc = merge_traces.merge(str(tmp_path))
+    assert doc["dist"]["num_ranks"] == 2
+    marker = doc["dist"]["rank_trace_missing"]
+    assert marker["ranks"] == [1]
+    assert "missing" in marker["reasons"]["1"]
+    # and check_trace --dist accepts the marker (markers never fail)
+    merged = tmp_path / "merged.json"
+    with open(merged, "w") as f:
+        json.dump(doc, f)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_trace.py"),
+         "--dist", str(merged)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+
+def test_merge_marks_truncated_rank_file(tmp_path):
+    # A rank file cut off mid-write (killed process) is invalid JSON:
+    # same marker path, with the reason naming the truncation.
+    _write_rank(tmp_path, 0, 2)
+    _write_rank(tmp_path, 1, 2)
+    full = (tmp_path / "trace-rank01.json").read_text()
+    (tmp_path / "trace-rank01.json").write_text(full[: len(full) // 2])
+    merge_traces = _load_tool("merge_traces")
+    doc = merge_traces.merge(str(tmp_path))
+    marker = doc["dist"]["rank_trace_missing"]
+    assert marker["ranks"] == [1]
+    assert "truncated" in marker["reasons"]["1"]
+
+
+def test_merge_still_fails_with_no_readable_rank(tmp_path):
+    (tmp_path / "trace-rank00.json").write_text("{not json")
     merge_traces = _load_tool("merge_traces")
     with pytest.raises(SystemExit):
         merge_traces.merge(str(tmp_path))

@@ -303,3 +303,100 @@ def test_moe_dispatch_flags_raise_on_dp_tp():
         train(steps=1, batch=32, dims=(8, 16, 24, 3), mesh_shape=(1, 4),
               parallelism="dp_ep", n_experts=4, moe_dispatch="dense",
               capacity_factor=0.25)
+
+
+# -- NaN/divergence guard -> checkpoint rollback (resilience) ----------------
+
+def _nan_sched(step, times=1):
+    from dmlp_tpu.resilience.inject import FaultSchedule
+    return FaultSchedule.from_dict(
+        {"schema": 1, "seed": 0, "faults": [
+            {"site": "train.step", "kind": "nan", "times": times,
+             "when": {"step": step}}]})
+
+
+@pytest.fixture()
+def _resilience_clean():
+    from dmlp_tpu.resilience import inject, stats
+    stats.reset()
+    inject.uninstall()
+    yield
+    inject.uninstall()
+    stats.reset()
+
+
+def test_nan_guard_rollback_is_step_identical(tmp_path, _resilience_clean):
+    """An injected non-finite loss at step 5 rolls back to the latest
+    checkpoint and replays; the run must end with EXACTLY the params an
+    unfaulted run produces (the chaos harness's train invariant)."""
+    from dmlp_tpu.resilience import inject, stats
+    kw = dict(steps=6, batch=64, dims=(6, 16, 3), mesh_shape=(1, 1),
+              ckpt_every=2, log_every=3, nan_guard=True)
+    plain, plain_last = train(checkpoint_dir=str(tmp_path / "ck_a"), **kw)
+
+    inject.install(_nan_sched(step=4))
+    faulted, faulted_last = train(checkpoint_dir=str(tmp_path / "ck_b"),
+                                  **kw)
+    assert stats.snapshot()["rollbacks"] == 1
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), plain["params"], faulted["params"])
+    assert plain_last["loss"] == faulted_last["loss"]
+    assert plain_last["step"] == faulted_last["step"] == 6
+
+
+def test_nan_guard_without_checkpoint_dir_raises(_resilience_clean):
+    from dmlp_tpu.resilience import inject
+    inject.install(_nan_sched(step=1))
+    with pytest.raises(RuntimeError, match="no.*checkpoint|checkpoint.*"):
+        train(steps=3, batch=32, dims=(4, 8, 2), mesh_shape=(1, 1),
+              log_every=3, nan_guard=True)
+
+
+def test_nan_guard_persistent_divergence_decays_lr(tmp_path,
+                                                   _resilience_clean):
+    """The same step diverging twice triggers LR backoff (x0.5) instead
+    of an identical-replay livelock; three strikes with max_rollbacks=2
+    gives up loudly."""
+    from dmlp_tpu.resilience import inject, stats
+    inject.install(_nan_sched(step=2, times=2))
+    state, _ = train(steps=4, batch=32, dims=(4, 8, 2), mesh_shape=(1, 1),
+                     checkpoint_dir=str(tmp_path / "ck"), ckpt_every=1,
+                     log_every=4, nan_guard=True)
+    assert stats.snapshot()["rollbacks"] == 2
+    assert int(state["step"]) == 4            # recovered and finished
+
+    inject.uninstall()
+    stats.reset()
+    inject.install(_nan_sched(step=2, times=5))
+    with pytest.raises(RuntimeError, match="persisted through"):
+        train(steps=4, batch=32, dims=(4, 8, 2), mesh_shape=(1, 1),
+              checkpoint_dir=str(tmp_path / "ck2"), ckpt_every=1,
+              log_every=4, nan_guard=True, max_rollbacks=2)
+
+
+def test_nan_guard_recovers_before_first_periodic_checkpoint(
+        tmp_path, _resilience_clean):
+    """ckpt_every beyond the faulted step: the guard seeds the dir with
+    the start state, so even step 1 divergence is recoverable."""
+    from dmlp_tpu.resilience import inject, stats
+    inject.install(_nan_sched(step=1))
+    state, _ = train(steps=4, batch=32, dims=(4, 8, 2), mesh_shape=(1, 1),
+                     checkpoint_dir=str(tmp_path / "ck"), ckpt_every=100,
+                     log_every=4, nan_guard=True)
+    assert stats.snapshot()["rollbacks"] == 1
+    assert int(state["step"]) == 4
+
+
+def test_nan_guard_refuses_stale_future_checkpoint(tmp_path,
+                                                   _resilience_clean):
+    """A checkpoint AHEAD of the faulted step (stale dir from an earlier
+    run) must fail loudly — rolling back may never jump forward."""
+    from dmlp_tpu.resilience import inject
+    ckdir = str(tmp_path / "ck")
+    train(steps=6, batch=32, dims=(4, 8, 2), mesh_shape=(1, 1),
+          checkpoint_dir=ckdir, ckpt_every=6, log_every=6)  # leaves step 6
+    inject.install(_nan_sched(step=2))
+    with pytest.raises(RuntimeError, match="AHEAD"):
+        train(steps=6, batch=32, dims=(4, 8, 2), mesh_shape=(1, 1),
+              checkpoint_dir=ckdir, ckpt_every=100, log_every=6,
+              nan_guard=True)

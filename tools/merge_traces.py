@@ -63,33 +63,51 @@ def fail(msg: str):
 
 
 def load_rank_files(trace_dir: str):
-    """-> list of (rank, doc), sorted by rank; validates the rank set."""
+    """-> (docs, missing): (rank, doc) pairs sorted by rank, plus a
+    {rank: reason} table for ranks whose file is absent or
+    unreadable/truncated. A missing rank is the EXPECTED artifact of
+    the failure being diagnosed (a crashed or hung process is exactly
+    when you need the surviving ranks' trace) — so the merge records
+    the explicit ``rank_trace_missing`` marker and proceeds instead of
+    refusing. At least one readable rank file is still required, and a
+    file whose embedded rank disagrees with its name still fails (that
+    is corruption of identity, not absence)."""
     paths = sorted(glob.glob(os.path.join(trace_dir, "trace-rank*.json")))
     if not paths:
         fail(f"no trace-rank*.json files in {trace_dir}")
     docs = []
+    missing = {}
     for p in paths:
         m = re.search(r"trace-rank(\d+)\.json$", p)
         if not m:
             continue
+        frank = int(m.group(1))
         try:
             with open(p) as f:
                 doc = json.load(f)
         except (OSError, json.JSONDecodeError) as e:
-            fail(f"{p} unreadable: {e}")
+            missing[frank] = f"unreadable or truncated: {e}"
+            continue
         dist = doc.get("dist") or {}
-        rank = dist.get("rank", int(m.group(1)))
-        if rank != int(m.group(1)):
-            fail(f"{p}: embedded rank {rank} != filename rank "
-                 f"{int(m.group(1))}")
+        rank = dist.get("rank", frank)
+        if rank != frank:
+            fail(f"{p}: embedded rank {rank} != filename rank {frank}")
         docs.append((rank, doc))
+    if not docs:
+        fail(f"no readable trace-rank*.json in {trace_dir} "
+             f"(all {len(missing)} candidate file(s) truncated?)")
     docs.sort()
-    ranks = [r for r, _ in docs]
-    want_n = docs[0][1].get("dist", {}).get("num_ranks", len(docs))
-    if ranks != list(range(want_n)):
-        fail(f"rank set {ranks} is not contiguous 0..{want_n - 1} "
-             "(a rank's trace is missing — crashed or never started?)")
-    return docs
+    present = {r for r, _ in docs}
+    want_n = docs[0][1].get("dist", {}).get(
+        "num_ranks", max(present | set(missing)) + 1)
+    beyond = sorted(r for r in present if r >= want_n)
+    if beyond:
+        fail(f"rank(s) {beyond} exceed the recorded num_ranks {want_n} "
+             "(inconsistent trace metadata)")
+    for r in range(want_n):
+        if r not in present and r not in missing:
+            missing[r] = "file missing (crashed or never started?)"
+    return docs, missing
 
 
 def sync_ts(doc, rank: int) -> float:
@@ -226,7 +244,12 @@ def straggler_analysis(docs, threshold: float = 1.5) -> dict:
 
 def merge(trace_dir: str, align: bool = True,
           straggler_threshold: float = 1.5) -> dict:
-    docs = load_rank_files(trace_dir)
+    docs, missing = load_rank_files(trace_dir)
+    if missing:
+        print(f"merge_traces: WARNING: rank trace(s) missing or "
+              f"truncated: { {r: missing[r] for r in sorted(missing)} } "
+              "— merging the surviving ranks with the explicit "
+              "rank_trace_missing marker", file=sys.stderr)
     offsets = {}
     if align:
         ref = sync_ts(docs[0][1], 0)
@@ -251,6 +274,9 @@ def merge(trace_dir: str, align: bool = True,
         if n_spans == 0:
             fail(f"rank {rank}: zero spans — tracing was installed but "
                  "nothing recorded")
+    # Cross-check only the SURVIVING ranks: a missing rank already
+    # carries its marker; divergence among present ranks is still a
+    # different-program error.
     if len(set(solve_counts.get(r, 0) for r, _ in docs)) > 1:
         fail(f"per-rank dist.solve span counts disagree: {solve_counts} "
              "(every rank runs the same contract solve; a mismatch means "
@@ -271,12 +297,17 @@ def merge(trace_dir: str, align: bool = True,
     events.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
                                e.get("ts", 0.0)))
     dist_block = {
-        "num_ranks": len(docs),
+        "num_ranks": len(docs) + len(missing),
         "aligned": bool(align),
         "clock_offsets_us": {str(r): offsets.get(r, 0.0)
                              for r, _ in docs},
         "span_counts": {str(r): span_counts[r] for r, _ in docs},
     }
+    if missing:
+        dist_block["rank_trace_missing"] = {
+            "ranks": sorted(missing),
+            "reasons": {str(r): missing[r] for r in sorted(missing)},
+        }
     reconcile = reconcile_comms(docs)
     if reconcile is not None:
         dist_block["comms_reconcile"] = reconcile
