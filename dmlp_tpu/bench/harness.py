@@ -282,7 +282,8 @@ def run_config(config_id: int, base_dir: str = ".",
                counters: bool = False,
                record_path: Optional[str] = None,
                profile_dir: Optional[str] = None,
-               obs_overhead: bool = False) -> dict:
+               obs_overhead: bool = False,
+               fused_ab: bool = False) -> dict:
     """Full benchmark flow for one config; returns a result summary dict.
 
     ``reps`` > 1 runs the engine subprocess that many times and reports
@@ -319,6 +320,17 @@ def run_config(config_id: int, base_dir: str = ".",
     so the obs layer's own overhead becomes a tracked ledger series
     instead of a "<2%, trust us" claim. Single-process configs only;
     failures record the explicit ``obs_overhead_unavailable`` marker.
+
+    ``fused_ab`` A/B-measures the fused distance→top-k megakernel
+    (ops.pallas_fused) against the two-pass pipeline it replaces: the
+    engine runs in interleaved ``DMLP_TPU_FUSED=1`` / ``=0`` pairs
+    (same alternating-order weather methodology), BOTH arms' stdout
+    must be byte-identical (the fused kernel's contract), and the
+    result records ``engine_ms_fused`` / ``engine_ms_two_pass``
+    medians with raw per-arm sample lists — the fused win (or loss)
+    becomes a gated ledger series (`tools/perf_gate.py`), not a prose
+    claim. Single-process configs only; failures and byte mismatches
+    record the explicit ``fused_ab_unavailable`` / identity fields.
     """
     import sys
 
@@ -487,6 +499,11 @@ def run_config(config_id: int, base_dir: str = ".",
         res.update(_measure_obs_overhead(
             cfg, input_path, outputs_dir, out, mode=mode, fast=fast,
             timeout_s=timeout_s, env=env, pairs=n_reps))
+    if fused_ab:
+        res.update(_measure_fused_ab(
+            cfg, input_path, outputs_dir, out, mode=mode, fast=fast,
+            timeout_s=timeout_s, env=env, pairs=n_reps,
+            oracle_want=want if check_reps else None))
     if record_path:
         _append_run_record(record_path, cfg, res, trace_dir,
                            profile=profile, cpu_pinned=cpu_pinned)
@@ -548,6 +565,135 @@ def _measure_obs_overhead(cfg: BenchConfig, input_path: str,
     return {"obs_overhead_pct": round(pct, 2),
             "engine_ms_obs_off": times["off"],
             "engine_ms_obs_on": times["on"]}
+
+
+def _measure_fused_ab(cfg: BenchConfig, input_path: str,
+                      outputs_dir: str, out: TextIO,
+                      mode: Optional[str], fast: bool,
+                      timeout_s: float, env: Optional[dict],
+                      pairs: int, oracle_want: Optional[str]) -> dict:
+    """Interleaved fused-megakernel vs two-pass engine timings (see
+    run_config docstring): ``DMLP_TPU_FUSED=1`` against ``=0``, order
+    alternating per pair so both arms share link weather. Three results
+    ride in the record:
+
+    - ``engine_ms_fused`` / ``engine_ms_two_pass`` medians plus the raw
+      ``*_reps`` lists (the ledger's per-trial evidence — the fused win
+      becomes a gated series, `tools/perf_gate.py`);
+    - ``fused_ab_pct``: median fused vs two-pass (negative = fused
+      faster);
+    - ``fused_ab_identical``: every fused-arm stdout byte-equal to every
+      two-pass-arm stdout (and to the oracle when the run is in exact
+      mode) — the megakernel's bit-identity contract, CHECKED per run,
+      not assumed. A mismatch marks the A/B unavailable (a wrong-output
+      arm's timing must not become a ledger point).
+
+    The A/B is never VACUOUS: both arms run with ``--metrics``
+    (symmetric, so the tiny cost-probe overhead cancels in the
+    comparison) and the fused arm's summary must report
+    ``extract_impl == "fused"`` — a config whose dispatch shape the
+    fused kernel does not support (or that never takes an extract-kernel
+    path at all) records the explicit ``fused_ab_vacuous`` marker
+    instead of an identical-code pair masquerading as a gated series.
+
+    Never raises: failures record ``fused_ab_unavailable``."""
+    import json
+    import statistics
+
+    if cfg.procs > 1:
+        return {"fused_ab_unavailable": "multi-process config (the A/B "
+                "drives the single-process engine CLI)"}
+    base_env = dict(env if env is not None else os.environ)
+    times: dict = {"fused": [], "two_pass": []}
+    outputs: dict = {"fused": set(), "two_pass": set()}
+    impls: dict = {"fused": set(), "two_pass": set()}
+    arm_env = {"fused": "1", "two_pass": "0"}
+    metrics_paths = {
+        arm: os.path.join(outputs_dir,
+                          f"fused_ab_metrics_{arm}_c{cfg.config_id}.jsonl")
+        for arm in arm_env}
+    for mpath in metrics_paths.values():
+        if os.path.exists(mpath):   # metrics JSONL appends; start clean
+            os.remove(mpath)
+    try:
+        for rep in range(max(pairs, 1)):
+            order = ("two_pass", "fused") if rep % 2 == 0 \
+                else ("fused", "two_pass")
+            for arm in order:
+                e = dict(base_env)
+                e["DMLP_TPU_FUSED"] = arm_env[arm]
+                out_path, err_path = run_engine(
+                    cfg, input_path, outputs_dir, mode=mode, fast=fast,
+                    timeout_s=timeout_s, env=e,
+                    obs_flags=["--metrics", metrics_paths[arm]])
+                with open(out_path) as f:
+                    outputs[arm].add(f.read())
+                with open(err_path) as f:
+                    ms = _extract_ms(f.read())
+                if ms is None:
+                    return {"fused_ab_unavailable":
+                            f"no timing line in the {arm}-arm run"}
+                times[arm].append(ms)
+    except (EngineTimeout, RuntimeError) as e:
+        return {"fused_ab_unavailable":
+                f"engine run failed during the A/B: {e}"}
+    metrics_err = None
+    for arm, mpath in metrics_paths.items():
+        try:
+            with open(mpath) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec.get("event") == "summary":
+                        impls[arm].add(rec.get("extract_impl"))
+        except (OSError, ValueError) as e:
+            metrics_err = f"{arm}-arm metrics channel unreadable: {e}"
+    identical = (len(outputs["fused"]) == 1
+                 and outputs["fused"] == outputs["two_pass"]
+                 and (oracle_want is None
+                      or outputs["fused"] == {oracle_want}))
+    if not identical:
+        return {"fused_ab_unavailable":
+                "fused/two-pass stdout MISMATCH — bit-identity contract "
+                "violated; timings withheld", "fused_ab_identical": False}
+    if metrics_err is not None or not impls["fused"]:
+        # The vacuity check below needs a parsed summary per arm; an
+        # unreadable/empty metrics channel is an INFRASTRUCTURE failure,
+        # not evidence about which kernel the config dispatches — report
+        # it as unavailable, never as vacuous (timings withheld: an A/B
+        # whose arms we cannot attribute must not become a gated series).
+        return {"fused_ab_identical": True,
+                "fused_ab_unavailable": metrics_err
+                or "no engine summary parsed from the A/B metrics "
+                   "channel — cannot attribute the arms to kernels"}
+    if impls["fused"] != {"fused"}:
+        # Identical arms AND the fused arm never dispatched the fused
+        # kernel: the pair measured the same code twice. An honest
+        # marker, not a ledger series (timings withheld).
+        return {"fused_ab_vacuous": True,
+                "fused_ab_identical": True,
+                "fused_ab_unavailable":
+                    "the DMLP_TPU_FUSED=1 arm dispatched "
+                    f"{sorted(str(i) for i in impls['fused'])} (not the "
+                    "fused kernel) — this config's solve never takes "
+                    "the fused path; an identical-code A/B must not "
+                    "become a gated series"}
+    med_f = statistics.median(times["fused"])
+    med_t = statistics.median(times["two_pass"])
+    res = {"fused_ab_identical": True,
+           "fused_ab_impls": {a_: sorted(str(i) for i in v)
+                              for a_, v in impls.items()},
+           "engine_ms_fused": round(med_f),
+           "engine_ms_fused_reps": times["fused"],
+           "engine_ms_two_pass": round(med_t),
+           "engine_ms_two_pass_reps": times["two_pass"]}
+    if med_t > 0:
+        pct = (med_f - med_t) / med_t * 100.0
+        res["fused_ab_pct"] = round(pct, 2)
+        out.write(f"Config {cfg.config_id}: fused A/B {pct:+.1f}% "
+                  f"(median {med_t} -> {med_f} ms over "
+                  f"{len(times['fused'])} interleaved pair(s), "
+                  "byte-identical)\n")
+    return res
 
 
 def _append_run_record(record_path: str, cfg: BenchConfig, res: dict,
@@ -663,6 +809,13 @@ def main(argv=None) -> int:
                         "interleaved engine pairs with tracing+counters "
                         "off vs on and record obs_overhead_pct in the "
                         "config's RunRecord (single-process configs)")
+    p.add_argument("--fused-ab", action="store_true",
+                   help="A/B the fused distance→top-k megakernel: run "
+                        "interleaved DMLP_TPU_FUSED=1/0 engine pairs, "
+                        "verify the arms byte-identical, and record "
+                        "engine_ms_fused / engine_ms_two_pass (+ raw "
+                        "rep lists) in the config's RunRecord "
+                        "(single-process configs)")
     args = p.parse_args(argv)
 
     ids = list(BENCH_CONFIGS) if args.config == "all" else [int(args.config)]
@@ -674,7 +827,8 @@ def main(argv=None) -> int:
                          trace_dir=args.trace_dir, counters=args.counters,
                          record_path=args.metrics,
                          profile_dir=args.profile_dir,
-                         obs_overhead=args.obs_overhead)
+                         obs_overhead=args.obs_overhead,
+                         fused_ab=args.fused_ab)
         # `timed_out` is a marker, not a verdict (markers never gate):
         # the config's RunRecord documents the hang; a wrong checksum
         # still fails the run.

@@ -77,6 +77,7 @@ def analyze_modules(modules: List[ModuleInfo],
                     ) -> List[Finding]:
     from dmlp_tpu.check.collectives import CollectiveRule
     from dmlp_tpu.check.compatrule import CompatRule
+    from dmlp_tpu.check.dispatchcost import DispatchCostRule
     from dmlp_tpu.check.hostsync import HostSyncRule
     from dmlp_tpu.check.hygiene import HygieneRule
     from dmlp_tpu.check.recompile import RecompileRule
@@ -90,6 +91,7 @@ def analyze_modules(modules: List[ModuleInfo],
         rules.append(HygieneRule())
     if "R1" in fams:
         rules.append(CollectiveRule(modules))
+        rules.append(DispatchCostRule(modules))
     if "R2" in fams:
         rules.append(RecompileRule())
     if "R3" in fams:

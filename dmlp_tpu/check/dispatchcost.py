@@ -1,0 +1,163 @@
+"""R1 extension — kernel-dispatch cost coverage (R105/R106).
+
+The measured-extraction-term contract (ISSUE 3/8): every Pallas top-k
+kernel dispatch in the engines is (a) recorded for the analytic cost
+counters (``obs_counters.record_dispatch`` resolving through
+``obs.kernel_cost.analytic_cost`` — pallas_call has no XLA cost
+analysis) and (b) paired with a ``MeasuredIters`` probe so the
+extraction term stays ``measured``, not modeled. Both halves drift
+silently: a new kernel (the fused megakernel) dispatched without a
+model skews every counters record low, and a dispatch loop without a
+probe quietly downgrades ``extraction_term`` for that path. These rules
+are the static half:
+
+- **R105**: a ``record_dispatch`` of a top-k kernel (a direct
+  ``ops.pallas_*`` import, or a variable bound from
+  ``resolve_topk_kernel``) whose enclosing function neither constructs
+  a ``MeasuredIters`` probe nor queues through ``_queue_iters`` — the
+  dispatch site would report a modeled (lower-bound) extraction term.
+- **R106**: a ``record_dispatch`` whose kernel argument resolves to a
+  ``dmlp_tpu.ops`` function with NO entry in the
+  ``obs.kernel_cost.analytic_cost`` model table (parsed statically from
+  kernel_cost.py — renaming a kernel away from its model, or adding a
+  kernel without one, fails ``make check`` instead of silently
+  under-counting FLOPs/HBM bytes).
+
+Both scope to ``engine/`` modules (the hot-path dispatch sites; tools
+and tests measure what they please) and honor the R1 family's
+``# check: allow-collective`` directive.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from dmlp_tpu.check.common import ModuleInfo, call_name
+from dmlp_tpu.check.findings import Finding
+
+#: functions whose return value IS a top-k kernel callable — names bound
+#: from their result are kernel variables for R105/R106 purposes
+KERNEL_RESOLVERS = {"resolve_topk_kernel"}
+
+#: the probe protocol: an enclosing function satisfies R105 when it
+#: constructs the accumulator itself or routes through the shared queue
+PROBE_CALLS = {"MeasuredIters", "_queue_iters"}
+
+
+def _modeled_kernels(modules: List[ModuleInfo]) -> Optional[Set[str]]:
+    """Kernel function names registered in ``analytic_cost``'s model
+    table, parsed from obs/kernel_cost.py — the analyzed copy when it
+    is part of this run, else the installed package's file (fixture
+    runs analyze a single temp file). None when neither parses: R106
+    then stays silent rather than flagging every dispatch."""
+    mod = next((m for m in modules
+                if m.relpath.endswith("obs/kernel_cost.py")), None)
+    tree = mod.tree if mod is not None else None
+    if tree is None:
+        try:
+            from dmlp_tpu.check.analyzer import package_root
+            path = os.path.join(package_root(), "obs", "kernel_cost.py")
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            return None
+    names: Set[str] = set()
+    # the registry shape: models = {id(pallas_x.kernel_name): _entry, ...}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for key in node.keys:
+            if isinstance(key, ast.Call) and call_name(key) == "id" \
+                    and key.args and isinstance(key.args[0],
+                                                ast.Attribute):
+                names.add(key.args[0].attr)
+    return names or None
+
+
+class DispatchCostRule:
+    """R105/R106 over every engine-module ``record_dispatch`` site."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self._modeled = _modeled_kernels(modules)
+
+    # -- per-module tables ---------------------------------------------------
+    def _ops_kernels(self, mod: ModuleInfo) -> dict:
+        """local name -> kernel function name, for names imported from
+        dmlp_tpu.ops (relative spellings included)."""
+        out = {}
+        for local, src in mod.imports.items():
+            parts = src.split(".")
+            if "ops" in parts[:-1]:
+                out[local] = parts[-1]
+        return out
+
+    @staticmethod
+    def _kernel_vars(fn: ast.AST) -> Set[str]:
+        """Names bound (incl. tuple-unpacked) from a KERNEL_RESOLVERS
+        call anywhere in ``fn`` — e.g. ``kern, impl =
+        pallas_fused.resolve_topk_kernel(...)`` binds ``kern``."""
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            name = call_name(node.value)
+            leaf = name.rsplit(".", 1)[-1] if name else None
+            if leaf not in KERNEL_RESOLVERS:
+                continue
+            for tgt in node.targets:
+                elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+                if elts and isinstance(elts[0], ast.Name):
+                    out.add(elts[0].id)
+        return out
+
+    @staticmethod
+    def _has_probe(fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                leaf = name.rsplit(".", 1)[-1] if name else None
+                if leaf in PROBE_CALLS:
+                    return True
+        return False
+
+    # -- driver --------------------------------------------------------------
+    def run(self, mod: ModuleInfo, add) -> None:
+        if "engine/" not in mod.relpath:
+            return
+        ops_kernels = self._ops_kernels(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            leaf = name.rsplit(".", 1)[-1] if name else None
+            if leaf != "record_dispatch" or not node.args \
+                    or not isinstance(node.args[0], ast.Name):
+                continue
+            arg = node.args[0].id
+            encl = mod.enclosing_funcs(node)
+            fn = encl[0] if encl else None
+            kernel_vars = self._kernel_vars(fn) if fn is not None \
+                else set()
+            is_kernel = arg in ops_kernels or arg in kernel_vars
+            if not is_kernel or mod.allowed(node, "allow-collective"):
+                continue
+            scope = mod.scope_of(node)
+            if fn is not None and not self._has_probe(fn):
+                add(Finding(
+                    "R105", mod.relpath, node.lineno, node.col_offset,
+                    scope, f"probe:{arg}",
+                    f"kernel dispatch site records {arg!r} but "
+                    f"{fn.name} threads no MeasuredIters/_queue_iters "
+                    f"probe — the extraction term degrades to modeled"))
+            if arg in ops_kernels and self._modeled is not None \
+                    and ops_kernels[arg] not in self._modeled:
+                add(Finding(
+                    "R106", mod.relpath, node.lineno, node.col_offset,
+                    scope, f"model:{ops_kernels[arg]}",
+                    f"dispatched kernel {ops_kernels[arg]!r} has no "
+                    f"entry in obs.kernel_cost.analytic_cost — its "
+                    f"counters would silently fall through to XLA "
+                    f"cost analysis (absent for pallas_call)"))

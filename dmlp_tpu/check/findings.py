@@ -24,6 +24,10 @@ RULES = {
     "R102": "collective axis absent from the enclosing shard_map specs",
     "R103": "collective call site has no analytic comms-model annotation",
     "R104": "comms-model annotation names a function obs/comms.py lacks",
+    "R105": "engine kernel dispatch site lacks a MeasuredIters/"
+            "_queue_iters probe (extraction term degrades to modeled)",
+    "R106": "dispatched kernel has no obs/kernel_cost analytic model"
+            " (counters silently under-count the dispatch)",
     # R2 — recompilation hazards
     "R201": "non-hashable default argument on a jit-compiled function",
     "R202": "f-string construction inside a traced (jit/shard_map) body",

@@ -38,6 +38,10 @@ RESOLUTION_FNS = {
     "resolve_variant", "_resolve_variant", "lookup_variant",
     "resolve_select", "resolve_streaming_select", "resolve_dtype",
     "resolve_granule", "resolve_data_block", "resolve_kcap",
+    # the fused-megakernel selection surface (ops.pallas_fused): which
+    # kernel runs — and the env kill switch that flips it — must be
+    # baked into the jit cache key, never read inside a traced body
+    "resolve_topk_kernel", "fused_enabled", "variant_for",
 }
 
 #: keyword-only parameter names that are plainly Python-level config —

@@ -79,7 +79,8 @@ def make_engine(config: EngineConfig, stderr=None):
 
 
 def _emit_metrics(path: str, args, inp, timer: EngineTimer, phase_ms: dict,
-                  counters: Optional[dict], comms: Optional[dict]) -> None:
+                  counters: Optional[dict], comms: Optional[dict],
+                  extract_impl: Optional[str] = None) -> None:
     """Append per-phase records + one run summary to the metrics JSONL.
 
     The summary is the contract record: it always carries a ``counters``
@@ -104,6 +105,11 @@ def _emit_metrics(path: str, args, inp, timer: EngineTimer, phase_ms: dict,
         }
         if comms is not None:
             summary["comms"] = comms
+        if extract_impl is not None:
+            # Which top-k kernel the solve actually dispatched ("fused"
+            # | "extract") — the bench harness's fused A/B reads this to
+            # refuse recording a vacuous (never-dispatched-fused) pair.
+            summary["extract_impl"] = extract_impl
         # Recovery is never silent: when the resilience layer did
         # anything (or a fault schedule was installed, even if nothing
         # fired), the summary carries the counters the chaos harness
@@ -311,7 +317,10 @@ def _run_cli(parser, args, stdin, stdout, stderr, tracer, probe) -> int:
             comms = summarize(engine.last_comms)
         if args.metrics:
             _emit_metrics(args.metrics, args, inp, timer, phase_ms,
-                          counters, comms)
+                          counters, comms,
+                          extract_impl=getattr(engine, "last_extract_impl",
+                                               None)
+                          if engine is not None else None)
         if args.counters:
             _emit_counters_stderr(counters, timer.elapsed_ms, stderr)
         if tracer is not None:

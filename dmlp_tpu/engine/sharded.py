@@ -98,6 +98,9 @@ class ShardedEngine:
         self.last_phase_ms: Dict[str, float] = {}
         self.last_hetk = None  # (bulk, outlier) counts when routing split
         self.last_comms: list = []  # obs.comms traffic of the last solve
+        # Which kernel the last extract-select solve baked into its mesh
+        # programs ("fused" | "extract" | None) — artifacts report it.
+        self.last_extract_impl = None
         # (site, device iters-sum scalar, shape) queue for the measured
         # extraction term — same protocol as engine.single (the mesh
         # programs return per-shard kernel iters through their fold
@@ -146,12 +149,37 @@ class ShardedEngine:
                 jax.device_put(ids, dsh1),
                 jax.device_put(q_attrs.astype(np_dtype, copy=False), qsh))
 
+    def _extract_impl(self, select: str, qb: int, b: int, a: int,
+                      k: int) -> str:
+        """Which top-k kernel ("fused" | "extract") the mesh programs
+        bake in for this per-cell dispatch shape — resolved HERE, on the
+        host, OUTSIDE every jitted program (lint R203), and threaded by
+        the callers into the ``_fns`` cache key of any compiled program
+        that bakes the choice in: the fused/two-pass selection is part
+        of the compiled-program cache key by construction (flipping
+        $DMLP_TPU_FUSED mid-process compiles the other program instead
+        of silently replaying the stale one). Non-extract selects pin
+        the default label without consulting the resolver (one guard
+        here instead of one per call site)."""
+        if select != "extract":
+            return "extract"
+        from dmlp_tpu.ops.pallas_fused import resolve_topk_kernel
+        _, impl = resolve_topk_kernel(
+            qb, b, a, k, rung=getattr(self, "_degrade_rung", "fused"))
+        impl = impl or "extract"  # plan already validated ex_supports
+        self.last_extract_impl = impl
+        return impl
+
     # -- the compiled sharded program ---------------------------------------
-    def _solve_shard_fn(self, k: int, data_block: int, select: str):
-        """Per-cell solver closure: the flagship extraction kernel when the
-        plan selected it (its SMEM runtime scalars make the per-shard
-        id_base/n_real traced values, so one compiled kernel serves every
-        shard), the streaming fold otherwise. Returns (TopK, iters)
+    def _solve_shard_fn(self, k: int, data_block: int, select: str,
+                        impl: str = "extract"):
+        """Per-cell solver closure: the flagship fused/extraction kernel
+        when the plan selected it (its SMEM runtime scalars make the
+        per-shard id_base/n_real traced values, so one compiled kernel
+        serves every shard), the streaming fold otherwise. ``impl``
+        ("fused" | "extract", from _extract_impl) picks which kernel an
+        extract-select program dispatches — the caller must key its
+        compiled-program cache on it. Returns (TopK, iters)
         where ``iters`` is this cell's summed kernel loop-iteration
         count as a (1, 1) i32 — the per-shard extract iters previously
         trapped inside the shard_map program, now threaded through the
@@ -162,6 +190,8 @@ class ShardedEngine:
         if select == "extract":
             from dmlp_tpu.ops.pallas_distance import native_pallas_backend
             from dmlp_tpu.ops.pallas_extract import extract_topk
+            from dmlp_tpu.ops.pallas_fused import fused_topk
+            kern = fused_topk if impl == "fused" else extract_topk
             interpret = not native_pallas_backend()
 
             def solve_shard(data_a, data_l, data_i, q_attrs):
@@ -171,9 +201,9 @@ class ShardedEngine:
                 # shard: base from the first id, count from the mask.
                 nreal = jnp.sum((data_i >= 0).astype(jnp.int32))
                 base = jnp.maximum(data_i[0], 0)
-                od, oi, its = extract_topk(q_attrs, data_a, n_real=nreal,
-                                           id_base=base, kc=k,
-                                           interpret=interpret)
+                od, oi, its = kern(q_attrs, data_a, n_real=nreal,
+                                   id_base=base, kc=k,
+                                   interpret=interpret)
                 lab = jnp.where(
                     oi >= 0, data_l[jnp.clip(oi - base, 0, sr - 1)], -1)
                 return TopK(od, lab, oi), \
@@ -189,11 +219,12 @@ class ShardedEngine:
             return top, jnp.zeros((1, 1), jnp.int32)
         return solve_shard
 
-    def _fn(self, k: int, data_block: int, select: str):
-        key = (k, data_block, select)
+    def _fn(self, k: int, data_block: int, select: str,
+            impl: str = "extract"):
+        key = (k, data_block, select, impl)
         if key not in self._fns:
             merge = self._merge_strategy
-            solve_shard = self._solve_shard_fn(k, data_block, select)
+            solve_shard = self._solve_shard_fn(k, data_block, select, impl)
 
             def local(data_a, data_l, data_i, q_attrs):
                 top, its = solve_shard(data_a, data_l, data_i, q_attrs)
@@ -249,21 +280,26 @@ class ShardedEngine:
             cfg, kmax, select, shard_rows * r, staging=self._staging)
 
     # -- pipelined chunked staging (VERDICT r3 item 1) -----------------------
-    def _chunk_fold_fn(self, k: int, interpret: bool):
+    def _chunk_fold_fn(self, k: int, interpret: bool,
+                       impl: str = "extract"):
         """Per-chunk fold program: every (row, col) cell folds its slice of
         the staged chunk into its running (qloc, K) lists with the
-        extraction kernel. ``sc = [n, toff, shard_rows]`` rides as traced
+        fused/extraction kernel (``impl``, resolved by _extract_impl
+        OUTSIDE this jit and part of this cache key). ``sc = [n, toff,
+        shard_rows]`` rides as traced
         scalars (the kernel takes them in SMEM), so ONE compiled program
         serves every chunk of every input at the same shapes."""
-        key = ("chunkfold", k, interpret)
+        key = ("chunkfold", k, interpret, impl)
         if key not in self._fns:
             from dmlp_tpu.ops.pallas_extract import extract_topk
+            from dmlp_tpu.ops.pallas_fused import fused_topk
+            kern = fused_topk if impl == "fused" else extract_topk
 
             def local(cd, ci, chunk_a, q_attrs, sc):
                 id_base, n_real = _chunk_span(sc, chunk_a.shape[0])
-                od, oi, its = extract_topk(q_attrs, chunk_a, cd[0], ci[0],
-                                           n_real=n_real, id_base=id_base,
-                                           kc=k, interpret=interpret)
+                od, oi, its = kern(q_attrs, chunk_a, cd[0], ci[0],
+                                   n_real=n_real, id_base=id_base,
+                                   kc=k, interpret=interpret)
                 # Per-cell summed kernel loop iterations ride out as a
                 # third fold output ((R, C) after shard_map) so the
                 # measured extraction term covers the mesh path too.
@@ -451,6 +487,7 @@ class ShardedEngine:
                          staging=self._staging)
         if not ex_supports(qloc, chunk_rows, na, k):
             return None
+        impl = self._extract_impl("extract", qloc, chunk_rows, na, k)
         interpret = not native_pallas_backend()
         self._last_select = "extract"
         if split is not None:
@@ -468,7 +505,7 @@ class ShardedEngine:
             np.ascontiguousarray(inp.labels, np.int32), rsh)
 
         cd, ci = self._chunk_init_fn(r, qpad, k)()
-        step = self._chunk_fold_fn(k, interpret)
+        step = self._chunk_fold_fn(k, interpret, impl)
 
         ostep = None
         if split is not None:
@@ -486,11 +523,11 @@ class ShardedEngine:
         src = np.ascontiguousarray(inp.data_attrs, np.float32)
         throttle = ChunkThrottle()
         mi = MeasuredIters(self, "sharded.chunk_fold",
-                           (qloc, chunk_rows, na, k))
-        from dmlp_tpu.ops.pallas_extract import resolve_variant
+                           (qloc, chunk_rows, na, k), kernel=impl)
+        from dmlp_tpu.ops.pallas_fused import variant_for
         with obs_span("sharded.enqueue_chunked", chunks=nchunks,
-                      mesh=[r, c], kc=k,
-                      variant=resolve_variant(k, chunk_rows, qloc, na)):
+                      mesh=[r, c], kc=k, impl=impl,
+                      variant=variant_for(impl, k, chunk_rows, qloc, na)):
             for t in range(nchunks):
                 toff = t * chunk_rows
                 # Staging buffer directly in the wire dtype: slice
@@ -552,6 +589,7 @@ class ShardedEngine:
         self.last_hetk = None    # routed=False below: no split ever fires
         self.last_comms = []     # no stale traffic either
         self._pending_iters = []
+        self.last_extract_impl = None
         out = self._solve_chunked_extract(inp, routed=False)
         if out is not None:
             top, _ = out
@@ -573,10 +611,13 @@ class ShardedEngine:
         """Dispatch the monolithic merged program, with obs hooks: the
         dispatch is recorded for cost-analysis counters and the merge's
         collective traffic is accounted from the dispatched shapes."""
-        fn = self._fn(k, data_block, select)
+        r, c = self.mesh.devices.shape
+        impl = self._extract_impl(select, q_attrs.shape[0] // c,
+                                  d_attrs.shape[0] // r,
+                                  d_attrs.shape[1], k)
+        fn = self._fn(k, data_block, select, impl)
         args = (d_attrs, d_labels, d_ids, q_attrs)
         obs_counters.record_dispatch(fn, args, site="sharded.solve_merge")
-        r, c = self.mesh.devices.shape
         self.last_comms = engine_comms(self._merge_strategy, (r, c),
                                        q_attrs.shape[0] // c, k)
         def _op():
@@ -591,17 +632,21 @@ class ShardedEngine:
             sp.fence(top.dists)
         self._queue_iters("sharded.solve_merge", select, its,
                           q_attrs.shape[0] // c, d_attrs.shape[0] // r,
-                          d_attrs.shape[1], k)
+                          d_attrs.shape[1], k, impl=impl)
         return top
 
     def _queue_iters(self, site: str, select: str, its,
-                     qloc: int, shard_rows: int, na: int, k: int) -> None:
+                     qloc: int, shard_rows: int, na: int, k: int,
+                     impl: str = "extract") -> None:
         """Queue a mesh program's per-shard kernel iters (summed over
         cells) for the post-fence measured-extraction-term flush; no-op
-        for non-extract selects or without an installed probe."""
+        for non-extract selects or without an installed probe. ``impl``
+        tags the shape so the measured term is costed at the dispatched
+        kernel's own resolved tiles (fused namespace when fused ran)."""
         if select != "extract":
             return
-        mi = MeasuredIters(self, site, (qloc, shard_rows, na, k))
+        mi = MeasuredIters(self, site, (qloc, shard_rows, na, k),
+                           kernel=impl)
         mi.add(its)
         mi.done()
 
@@ -614,6 +659,7 @@ class ShardedEngine:
         self.last_phase_ms = {}
         self.last_comms = []
         self._pending_iters = []
+        self.last_extract_impl = None
         out = self._solve_chunked_extract(inp)
         if isinstance(out, list):
             return out
@@ -641,7 +687,10 @@ class ShardedEngine:
         select, data_block, k = self._plan_shard(d_attrs, q_attrs, kmax,
                                                  merged_width=True)
         r, c = self.mesh.devices.shape
-        fn = self._fn(k, data_block, select)
+        impl = self._extract_impl(select, q_attrs.shape[0] // c,
+                                  d_attrs.shape[0] // r,
+                                  d_attrs.shape[1], k)
+        fn = self._fn(k, data_block, select, impl)
 
         def _op():
             rs_inject.fire("sharded.solve", which="global")
@@ -650,7 +699,7 @@ class ShardedEngine:
         top, its = rs_retry.call_with_retry(_op, "sharded.solve")
         self._queue_iters("sharded.solve_global", select, its,
                           q_attrs.shape[0] // c, d_attrs.shape[0] // r,
-                          d_attrs.shape[1], k)
+                          d_attrs.shape[1], k, impl=impl)
         return top
 
     def _plan_shard(self, d_attrs, q_attrs, kmax: int, merged_width: bool):
@@ -691,16 +740,17 @@ class ShardedEngine:
         return select, data_block, k
 
     # -- per-shard program (no cross-shard merge) ---------------------------
-    def _fn_local(self, k: int, data_block: int, select: str):
+    def _fn_local(self, k: int, data_block: int, select: str,
+                  impl: str = "extract"):
         """Compiled per-cell top-k with out_specs keeping BOTH mesh axes:
         output (R, Qpad, K) sharded P("data", "query", None). No collective
         runs inside the jit — the multi-host contract path rescores each
         data shard's candidates in float64 on the process that owns the
         shard, then merges on host (parallel.distributed), so the exact
         merge must not happen in f32 on device first."""
-        key = ("local", k, data_block, select)
+        key = ("local", k, data_block, select, impl)
         if key not in self._fns:
-            solve_shard = self._solve_shard_fn(k, data_block, select)
+            solve_shard = self._solve_shard_fn(k, data_block, select, impl)
 
             def local(data_a, data_l, data_i, q_attrs):
                 top, its = solve_shard(data_a, data_l, data_i, q_attrs)
@@ -729,11 +779,14 @@ class ShardedEngine:
         (TopK of shape (R, Qpad, K), sharded over both mesh axes)."""
         select, data_block, k = self._plan_shard(d_attrs, q_attrs, kmax,
                                                  merged_width=False)
-        fn = self._fn_local(k, data_block, select)
+        r, c = self.mesh.devices.shape
+        impl = self._extract_impl(select, q_attrs.shape[0] // c,
+                                  d_attrs.shape[0] // r,
+                                  d_attrs.shape[1], k)
+        fn = self._fn_local(k, data_block, select, impl)
         obs_counters.record_dispatch(fn, (d_attrs, d_labels, d_ids,
                                           q_attrs),
                                      site="sharded.solve_local_shards")
-        r, c = self.mesh.devices.shape
 
         def _op():
             rs_inject.fire("sharded.solve", which="local_shards")
@@ -744,7 +797,7 @@ class ShardedEngine:
             top, its = rs_retry.call_with_retry(_op, "sharded.solve")
         self._queue_iters("sharded.solve_local_shards", select, its,
                           q_attrs.shape[0] // c, d_attrs.shape[0] // r,
-                          d_attrs.shape[1], k)
+                          d_attrs.shape[1], k, impl=impl)
         return top
 
     def run(self, inp: KNNInput) -> List[QueryResult]:
@@ -821,14 +874,14 @@ class ShardedEngine:
         return merged
 
     def _fn_full(self, k: int, data_block: int, select: str,
-                 num_labels: int):
+                 num_labels: int, impl: str = "extract"):
         """Compiled all-device pipeline: per-cell top-k -> cross-shard
         merge -> vote + report ordering, all query-sharded on device (the
         sharded analog of single._full_blocks)."""
-        key = ("full", k, data_block, select, num_labels)
+        key = ("full", k, data_block, select, num_labels, impl)
         if key not in self._fns:
             merge = self._merge_strategy
-            solve_shard = self._solve_shard_fn(k, data_block, select)
+            solve_shard = self._solve_shard_fn(k, data_block, select, impl)
 
             def local(data_a, data_l, data_i, q_attrs, ks):
                 from dmlp_tpu.ops.vote import majority_vote, report_order
@@ -880,6 +933,7 @@ class ShardedEngine:
         self.last_hetk = None
         self.last_comms = []
         self._pending_iters = []
+        self.last_extract_impl = None
         out = self._solve_chunked_extract(inp)
         if out is not None:
             from dmlp_tpu.engine.single import _device_epilogue
@@ -919,11 +973,14 @@ class ShardedEngine:
         ks_pad[:nq] = inp.ks
         ks_dev = jax.device_put(ks_pad, ksh)
 
-        fn_full = self._fn_full(k, data_block, select, num_labels)
+        r, c = self.mesh.devices.shape
+        impl = self._extract_impl(select, qpad // c,
+                                  d_attrs.shape[0] // r,
+                                  d_attrs.shape[1], k)
+        fn_full = self._fn_full(k, data_block, select, num_labels, impl)
         full_args = (d_attrs, d_labels, d_ids, q_attrs, ks_dev)
         obs_counters.record_dispatch(fn_full, full_args,
                                      site="sharded.device_full")
-        r, c = self.mesh.devices.shape
         self.last_comms = engine_comms(self._merge_strategy, (r, c),
                                        qpad // c, k)
         with obs_span("sharded.device_full", select=select,
@@ -932,7 +989,7 @@ class ShardedEngine:
             sp.fence(d)
         self._queue_iters("sharded.device_full", select, its,
                           qpad // c, d_attrs.shape[0] // r,
-                          d_attrs.shape[1], k)
+                          d_attrs.shape[1], k, impl=impl)
         p, i, d = resilient_get((p, i, d), site="sharded.fetch")
         preds = p[:nq]
         rids = i[:nq]

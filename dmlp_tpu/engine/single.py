@@ -261,17 +261,22 @@ def hetk_split(cfg: EngineConfig, staging: str, ks: np.ndarray,
 
 
 class MeasuredIters:
-    """Lazy per-site accumulator for the extract kernel's iteration
-    diagnostics: ``add()`` chains a tiny on-device ``jnp.sum`` per
-    dispatch (no-op unless a cost probe is installed), ``done()`` queues
-    the site's device scalar on ``engine._pending_iters`` for the
+    """Lazy per-site accumulator for the extract/fused kernels'
+    iteration diagnostics: ``add()`` chains a tiny on-device ``jnp.sum``
+    per dispatch (no-op unless a cost probe is installed), ``done()``
+    queues the site's device scalar on ``engine._pending_iters`` for the
     post-fence flush (engine._flush_measured_iters) — ONE copy of the
-    protocol for the four extract paths instead of four."""
+    protocol for the extract paths instead of one per path. ``kernel``
+    ("extract" | "fused") rides along so the measured extraction term
+    costs its iterations at the kernel's OWN resolved tiles (the fused
+    tune-cache namespace can pin different ones)."""
 
     def __init__(self, engine, site: str,
-                 shape: Tuple[int, int, int, int]):
+                 shape: Tuple[int, int, int, int],
+                 kernel: str = "extract"):
         self._on = obs_counters.active() is not None
-        self._engine, self._site, self._shape = engine, site, shape
+        self._engine, self._site = engine, site
+        self._shape, self._kernel = tuple(shape), kernel
         self._sum = None
 
     def add(self, iters) -> None:
@@ -282,7 +287,7 @@ class MeasuredIters:
     def done(self) -> None:
         if self._sum is not None:
             self._engine._pending_iters.append(
-                (self._site, self._sum, self._shape))
+                (self._site, self._sum, self._shape, self._kernel))
 
 
 def flush_measured_iters(engine) -> None:
@@ -297,10 +302,10 @@ def flush_measured_iters(engine) -> None:
     engine._pending_iters = []
     if not pend:
         return
-    for site, s, shape in pend:
+    for site, s, shape, kernel in pend:
         try:
             obs_counters.record_measured_iters(  # check: allow-host-sync
-                site, int(jax.device_get(s)), shape)
+                site, int(jax.device_get(s)), shape, kernel=kernel)
         except Exception:  # check: no-retry
             pass  # observability must never fail the solve
 
@@ -476,11 +481,16 @@ class SingleChipEngine:
         self.last_phase_ms: dict = {}
         self.last_hetk = None  # (bulk, outlier) counts when routing split
         self.last_mp_passes = 0  # multi-pass extraction pass count
-        # Degradation-ladder rung (resilience.degrade): "streaming"
-        # forces the chunk-fold driver (no extract-kernel dispatch);
+        # Which kernel the last extract-path solve dispatched
+        # ("fused" | "extract" | None) — bench/artifacts report it.
+        self.last_extract_impl = None
+        # Degradation-ladder rung (resilience.degrade): "fused" (the
+        # default) allows the fused megakernel; "tuned" drops to the
+        # two-pass extraction kernel; "streaming" forces the chunk-fold
+        # driver (no extract-kernel dispatch at all);
         # last_degrade_rung reports the rung the last run() settled on.
-        self._degrade_rung = "tuned"
-        self.last_degrade_rung = "tuned"
+        self._degrade_rung = "fused"
+        self.last_degrade_rung = "fused"
         self._mp_hazard = None   # its per-query loss flags (run() repairs)
         # (site, device iters-sum scalar, (qb, b, a, kc)) triples the
         # extract paths queue when a cost probe is installed; flushed to
@@ -626,9 +636,8 @@ class SingleChipEngine:
         """
         import time as _time
 
+        from dmlp_tpu.ops import pallas_fused
         from dmlp_tpu.ops.pallas_distance import native_pallas_backend
-        from dmlp_tpu.ops.pallas_extract import extract_topk
-        from dmlp_tpu.ops.pallas_extract import supports as extract_supports
 
         cfg = self.config
         n = inp.params.num_data
@@ -650,10 +659,17 @@ class SingleChipEngine:
         kmax = int(inp.ks.max())
         k = resolve_kcap(cfg, kmax, "extract", nchunks * chunk_rows,
                          staging=self._staging)
-        if not extract_supports(qpad, chunk_rows, na, k):
+        # Fused-vs-two-pass selection, resolved HERE (outside any jitted
+        # body, lint R203): kern is a concrete Python callable whose own
+        # jit keys on mxu_gate + the resolved tiles, so the choice is
+        # part of the jit cache key by construction.
+        kern, impl = pallas_fused.resolve_topk_kernel(
+            qpad, chunk_rows, na, k, rung=self._degrade_rung)
+        if kern is None:
             return None
         interpret = not native_pallas_backend()
         self._last_select = "extract"
+        self.last_extract_impl = impl
 
         q_attrs = np.zeros((qpad, na), np.float32)
         q_attrs[:nq] = inp.query_attrs
@@ -661,11 +677,12 @@ class SingleChipEngine:
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
         od = oi = None
         mi = MeasuredIters(self, "single.extract_topk",
-                           (qpad, chunk_rows, na, k))
+                           (qpad, chunk_rows, na, k), kernel=impl)
         throttle = ChunkThrottle()
-        from dmlp_tpu.ops.pallas_extract import resolve_variant
         with obs_span("single.enqueue_extract", chunks=nchunks, kc=k,
-                      variant=resolve_variant(k, chunk_rows, qpad, na)):
+                      impl=impl,
+                      variant=pallas_fused.variant_for(
+                          impl, k, chunk_rows, qpad, na)):
             for c in range(nchunks):
                 lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
                 if lo >= n:
@@ -678,10 +695,10 @@ class SingleChipEngine:
                     # Resolved via the analytic kernel model
                     # (obs.kernel_cost) — pallas_call has no XLA cost.
                     obs_counters.record_dispatch(
-                        extract_topk, (q_dev, da), statics=dict(kc=k),
+                        kern, (q_dev, da), statics=dict(kc=k),
                         count=min(nchunks, -(-n // chunk_rows)),
                         site="single.extract_topk")
-                od, oi, _iters = extract_topk(
+                od, oi, _iters = kern(
                     q_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=k,
                     interpret=interpret)
                 mi.add(_iters)
@@ -732,9 +749,9 @@ class SingleChipEngine:
         """
         import time as _time
 
+        from dmlp_tpu.ops import pallas_fused
         from dmlp_tpu.ops.pallas_distance import native_pallas_backend
-        from dmlp_tpu.ops.pallas_extract import QUERY_TILE, extract_topk
-        from dmlp_tpu.ops.pallas_extract import supports as extract_supports
+        from dmlp_tpu.ops.pallas_extract import QUERY_TILE
 
         cfg = self.config
         n = inp.params.num_data
@@ -762,27 +779,36 @@ class SingleChipEngine:
         if npad * na * itemsize > self._MP_RESIDENT_BUDGET:
             return None
         qpad = round_up(nq, QUERY_TILE)
-        if not extract_supports(qpad, chunk_rows, na, kc):
+        kern, impl = pallas_fused.resolve_topk_kernel(
+            qpad, chunk_rows, na, kc, rung=self._degrade_rung)
+        if kern is None:
             return None
-        # ADVICE r5 (single.py:614): passes 2+ dispatch extract_topk over
+        # ADVICE r5 (single.py:614): passes 2+ dispatch the kernel over
         # the FULL concatenated d_full array, not chunk_rows — today the
         # 128*ne divisibility and tile caps happen to carry from
         # chunk_rows to its multiples, but supports() resolves its
         # variant per row count and nothing guaranteed the carry-over.
         # Assert the invariant the whole-array sweep actually needs, so
         # future variant tuning fails loudly here instead of silently
-        # mis-tiling every pass after the first.
+        # mis-tiling every pass after the first. The fused/two-pass
+        # selection resolves INDEPENDENTLY per row count (the fused
+        # tune-cache namespace may pin a variant at one bucket only), so
+        # pass 1 and the resident passes may legally run different
+        # kernels — each is bit-identical, so the union is too.
         n_staged = min(nchunks, -(-n // chunk_rows))
         full_rows = n_staged * chunk_rows
-        if not extract_supports(qpad, full_rows, na, kc):
+        kern_full, impl_full = pallas_fused.resolve_topk_kernel(
+            qpad, full_rows, na, kc, rung=self._degrade_rung)
+        if kern_full is None:
             raise AssertionError(
                 f"multi-pass extract: full-array sweep shape (qb={qpad}, "
                 f"rows={full_rows}, a={na}, kc={kc}) is untileable even "
                 f"though the per-chunk shape (rows={chunk_rows}) tiles — "
-                "extract_supports invariants diverged between the chunked "
+                "supports() invariants diverged between the chunked "
                 "pass 1 and the resident passes 2+")
         interpret = not native_pallas_backend()
         self._last_select = "extract"
+        self.last_extract_impl = impl
         rs_inject.fire("single.extract_solve", rung=self._degrade_rung,
                        path="multipass")
 
@@ -797,7 +823,7 @@ class SingleChipEngine:
         chunks: List[Tuple] = []
         od = oi = None
         mi = MeasuredIters(self, "single.extract_mp_pass1",
-                           (qpad, chunk_rows, na, kc))
+                           (qpad, chunk_rows, na, kc), kernel=impl)
         throttle = ChunkThrottle()
         for c in range(nchunks):
             lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
@@ -808,12 +834,12 @@ class SingleChipEngine:
             da = stage_put(a, self._staging)
             if c == 0:
                 obs_counters.record_dispatch(
-                    extract_topk, (q_dev, da), statics=dict(kc=kc),
+                    kern, (q_dev, da), statics=dict(kc=kc),
                     count=n_staged, site="single.extract_mp_pass1")
             chunks.append((da, lo, hi))
-            od, oi, _iters = extract_topk(q_dev, da, od, oi, n_real=hi - lo,
-                                          id_base=lo, kc=kc,
-                                          interpret=interpret)
+            od, oi, _iters = kern(q_dev, da, od, oi, n_real=hi - lo,
+                                  id_base=lo, kc=kc,
+                                  interpret=interpret)
             mi.add(_iters)
             throttle.tick(od)
         mi.done()
@@ -848,18 +874,18 @@ class SingleChipEngine:
         # otherwise the dataset is HBM-resident TWICE for the whole sweep
         if npasses > 1:
             obs_counters.record_dispatch(
-                extract_topk, (q_dev, d_full), statics=dict(kc=kc),
+                kern_full, (q_dev, d_full), statics=dict(kc=kc),
                 count=npasses - 1, site="single.extract_mp_resident")
         fds = []
         mir = MeasuredIters(self, "single.extract_mp_resident",
-                            (qpad, full_rows, na, kc))
+                            (qpad, full_rows, na, kc), kernel=impl_full)
         for _p in range(1, npasses):
             floor_dev, fd = _mp_floor(ods[-1], qn_dev, dn_dev,
                                       staging=self._staging, na=na)
             fds.append(fd)
-            od, oi, _iters = extract_topk(q_dev, d_full, n_real=n, id_base=0,
-                                          kc=kc, interpret=interpret,
-                                          floor=floor_dev)
+            od, oi, _iters = kern_full(q_dev, d_full, n_real=n, id_base=0,
+                                       kc=kc, interpret=interpret,
+                                       floor=floor_dev)
             mir.add(_iters)
             throttle.tick(od)
             ods.append(od)
@@ -897,6 +923,7 @@ class SingleChipEngine:
     def _solve(self, inp: KNNInput) -> Tuple[TopK, int]:
         self.last_phase_ms = {}  # no stale phases if a path is skipped
         self._pending_iters = []
+        self.last_extract_impl = None
         select = self.config.resolve_select(
             round_up(max(inp.params.num_data, 1), 8))
         if select == "sort":
@@ -929,9 +956,9 @@ class SingleChipEngine:
         """
         import time as _time
 
+        from dmlp_tpu.ops import pallas_fused
         from dmlp_tpu.ops.pallas_distance import native_pallas_backend
-        from dmlp_tpu.ops.pallas_extract import QUERY_TILE, extract_topk
-        from dmlp_tpu.ops.pallas_extract import supports as extract_supports
+        from dmlp_tpu.ops.pallas_extract import QUERY_TILE
         from dmlp_tpu.ops.topk import streaming_fallback
 
         bulk, outl = plan
@@ -945,13 +972,16 @@ class SingleChipEngine:
         qpad_b = round_up(len(bulk), QUERY_TILE)
         kb = resolve_kcap(cfg, int(inp.ks[bulk].max()), "extract",
                           nchunks * chunk_rows, staging=self._staging)
-        if not extract_supports(qpad_b, chunk_rows, na, kb):
+        kern, impl = pallas_fused.resolve_topk_kernel(
+            qpad_b, chunk_rows, na, kb, rung=self._degrade_rung)
+        if kern is None:
             return None
         select_out = streaming_fallback(cfg.use_pallas)
         ko = resolve_kcap(cfg, int(inp.ks[outl].max()), select_out,
                           nchunks * chunk_rows, staging=self._staging)
         interpret = not native_pallas_backend()
         self._last_select = "extract"
+        self.last_extract_impl = impl
         self.last_hetk = (int(bulk.size), int(outl.size))
         rs_inject.fire("single.extract_solve", rung=self._degrade_rung,
                        path="routed")
@@ -971,7 +1001,7 @@ class SingleChipEngine:
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
         od = oi = None
         mi = MeasuredIters(self, "single.extract_bulk",
-                           (qpad_b, chunk_rows, na, kb))
+                           (qpad_b, chunk_rows, na, kb), kernel=impl)
         throttle = ChunkThrottle()
         for c in range(nchunks):
             lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
@@ -983,10 +1013,10 @@ class SingleChipEngine:
             da = stage_put(a, self._staging)
             if c == 0:
                 obs_counters.record_dispatch(
-                    extract_topk, (qb_dev, da), statics=dict(kc=kb),
+                    kern, (qb_dev, da), statics=dict(kc=kb),
                     count=min(nchunks, -(-n // chunk_rows)),
                     site="single.extract_bulk")
-            od, oi, _iters = extract_topk(
+            od, oi, _iters = kern(
                 qb_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=kb,
                 interpret=interpret)
             mi.add(_iters)
@@ -1019,6 +1049,7 @@ class SingleChipEngine:
         self._mp_hazard = None
         self.last_mp_passes = 0
         self._pending_iters = []
+        self.last_extract_impl = None
         # Both routed and multipass paths dispatch the extraction
         # kernel; the "streaming" rung skips straight to _solve, whose
         # own gate lands on the chunk-fold driver.

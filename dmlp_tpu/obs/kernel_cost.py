@@ -44,12 +44,25 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-__all__ = ["extract_topk_cost", "extract_loop_cost",
-           "fused_dist_segmin_cost", "analytic_cost"]
+__all__ = ["extract_topk_cost", "extract_loop_cost", "fused_topk_cost",
+           "two_pass_equivalent_cost", "fused_dist_segmin_cost",
+           "analytic_cost"]
+
+
+def _variant_resolver(kernel: str):
+    """The ``_resolve_variant`` of the tune-cache namespace ``kernel``
+    ("extract" | "fused") costs its tiles through — ONE mapping so a
+    new kernel namespace (or a rename) cannot update one model and
+    silently leave another costing at the wrong namespace's tiles."""
+    if kernel == "fused":
+        from dmlp_tpu.ops.pallas_fused import _resolve_variant
+    else:
+        from dmlp_tpu.ops.pallas_extract import _resolve_variant
+    return _resolve_variant
 
 
 def extract_loop_cost(qb: int, b: int, a: int, kc: int,
-                      iters_total: int) -> float:
+                      iters_total: int, kernel: str = "extract") -> float:
     """MEASURED extraction-loop FLOPs for ``iters_total`` recorded loop
     iterations (summed over the kernel's (Qb/tq, B/tn) ``iters`` output,
     possibly across many dispatches at the same shape).
@@ -61,28 +74,32 @@ def extract_loop_cost(qb: int, b: int, a: int, kc: int,
     (~4*tq*kc) — so ~5*tq*tn + 4*ne*tq*kc FLOPs per round. ``a`` (the
     attribute width) does not enter the loop arithmetic but DOES enter
     variant resolution (the tuner cache keys on it and the VMEM gate
-    scales with it), so it must match the dispatch."""
+    scales with it), so it must match the dispatch. ``kernel``
+    ("extract" | "fused") selects WHICH tune-cache namespace the tiles
+    resolve through — the fused megakernel may run different tiles, so
+    its measured iterations must be costed at its own resolution."""
     from dmlp_tpu.ops.pallas_distance import _tile
-    from dmlp_tpu.ops.pallas_extract import _TN, _resolve_variant
+    from dmlp_tpu.ops.pallas_extract import _TN
 
-    v = _resolve_variant(kc, b, qb, a)
+    v = _variant_resolver(kernel)(kc, b, qb, a)
     tq = _tile(qb, v["tile_q"], 8)
     tn = _tile(b, v.get("tile_n", _TN), 128 * v["ne"])
     round_flops = 5.0 * tq * tn + 4.0 * v["ne"] * tq * kc
     return float(iters_total) * v.get("unroll", 1) * round_flops
 
 
-def extract_topk_cost(qb: int, b: int, a: int, kc: int,
-                      iters_total: Optional[int] = None) -> Dict[str, float]:
-    """Cost of one ``ops.pallas_extract.extract_topk`` dispatch at
-    (queries (qb, a), data (b, a), list width kc). Without
-    ``iters_total`` the data-dependent while-loop is excluded
-    (deterministic lower bound); with it, the measured extraction term
-    (:func:`extract_loop_cost`) is added and the dict says so."""
+def _streaming_cost(qb: int, b: int, a: int, kc: int,
+                    kernel: str = "extract") -> Dict[str, float]:
+    """The SHARED deterministic model of one streaming top-k dispatch
+    (the (qb, b) distance tile lives only in VMEM): flops + HBM bytes
+    at the tiles the ``kernel`` namespace ("extract" | "fused")
+    resolves for this shape. One body for both kernels — the fused
+    megakernel adds only its gate term on top — so a future fix to any
+    shared term cannot drift between the two models."""
     from dmlp_tpu.ops.pallas_distance import _tile
-    from dmlp_tpu.ops.pallas_extract import _TN, _resolve_variant
+    from dmlp_tpu.ops.pallas_extract import _TN
 
-    v = _resolve_variant(kc, b, qb, a)
+    v = _variant_resolver(kernel)(kc, b, qb, a)
     tq = _tile(qb, v["tile_q"], 8)
     tn = _tile(b, v.get("tile_n", _TN), 128 * v["ne"])
     flops = (2.0 * qb * b * a      # MXU cross-term block
@@ -95,13 +112,87 @@ def extract_topk_cost(qb: int, b: int, a: int, kc: int,
                   + (b // tn) * qb      # qn column, once per data block
                   + 2 * qb * kc         # running (dists, ids) lists out
                   + qb // tq * (b // tn))  # iteration diagnostics
-    out = {"flops": flops, "bytes_accessed": byts,
+    return {"flops": flops, "bytes_accessed": byts,
+            "tq": tq, "tn": tn}
+
+
+def extract_topk_cost(qb: int, b: int, a: int, kc: int,
+                      iters_total: Optional[int] = None) -> Dict[str, float]:
+    """Cost of one ``ops.pallas_extract.extract_topk`` dispatch at
+    (queries (qb, a), data (b, a), list width kc). Without
+    ``iters_total`` the data-dependent while-loop is excluded
+    (deterministic lower bound); with it, the measured extraction term
+    (:func:`extract_loop_cost`) is added and the dict says so."""
+    base = _streaming_cost(qb, b, a, kc)
+    out = {"flops": base["flops"], "bytes_accessed": base["bytes_accessed"],
            "extraction_term": "modeled_lower_bound"}
     if iters_total is not None:
         out["flops"] += extract_loop_cost(qb, b, a, kc, iters_total)
         out["extraction_term"] = "measured"
         out["extract_iters_total"] = int(iters_total)
     return out
+
+
+def fused_topk_cost(qb: int, b: int, a: int, kc: int,
+                    iters_total: Optional[int] = None) -> Dict[str, float]:
+    """Cost of one ``ops.pallas_fused.fused_topk`` dispatch — the fused
+    distance→top-k streaming megakernel. Same one-pass HBM structure as
+    :func:`extract_topk_cost` (the (qb, b) distance tile lives only in
+    VMEM), with tiles resolved from the FUSED tune-cache namespace and
+    the per-block norm-bound MXU gate added to the deterministic FLOPs
+    (one VPU pass over the block's dn row + a per-row bound: the price
+    of being able to skip the matmul outright).
+
+    The dict also quantifies what the fusion ELIMINATES: the two-pass
+    pipeline's HBM write+read of the full (qb, b) distance matrix
+    (:func:`two_pass_equivalent_cost`), as
+    ``hbm_bytes_two_pass_equiv`` / ``hbm_bytes_saved_vs_two_pass`` /
+    ``hbm_traffic_reduction_x`` — the ROADMAP's "one HBM pass for the
+    whole hot path" claim as a checked number, not prose. Both sides of
+    that delta resolve through the SAME (fused) tile namespace, so the
+    saved bytes are EXACTLY the 2·4·qb·b distance round-trip — a cached
+    fused variant with different tiles than the extract namespace
+    cannot leak tile-resolution differences into the metric."""
+    base = _streaming_cost(qb, b, a, kc, kernel="fused")
+    tq, tn = base["tq"], base["tn"]
+    flops = (base["flops"]
+             # The MXU gate itself, per (tq, tn) grid cell: ~3 block
+             # reductions over the dn row + ~8 scalar ops per query row
+             # for the (|q|-|d|)^2 bound and its eps deflation. (The
+             # cross-term block above is an upper bound: gated-out
+             # blocks skip the matmul entirely.)
+             + (qb // tq) * (b // tn) * (3.0 * tn + 8.0 * tq))
+    byts = base["bytes_accessed"]
+    tp = two_pass_equivalent_cost(qb, b, a, kc)
+    out: Dict[str, float] = {
+        "flops": flops, "bytes_accessed": byts,
+        "extraction_term": "modeled_lower_bound",
+        "hbm_bytes_two_pass_equiv": tp["bytes_accessed"],
+        "hbm_bytes_saved_vs_two_pass": tp["bytes_accessed"] - byts,
+        "hbm_traffic_reduction_x": round(tp["bytes_accessed"] / byts, 2),
+    }
+    if iters_total is not None:
+        out["flops"] += extract_loop_cost(qb, b, a, kc, iters_total,
+                                          kernel="fused")
+        out["extraction_term"] = "measured"
+        out["extract_iters_total"] = int(iters_total)
+    return out
+
+
+def two_pass_equivalent_cost(qb: int, b: int, a: int, kc: int,
+                             kernel: str = "fused") -> Dict[str, float]:
+    """What the SAME dispatch costs when the (qb, b) distance matrix
+    round-trips HBM between a distance kernel and a selection pass —
+    the pre-fused hot path's two passes over its dominant term:
+    everything the streaming kernel reads anyway, PLUS one full write
+    and one full re-read of the f32 distance tile. ``kernel`` picks the
+    tile namespace of the streaming base; it defaults to "fused" so the
+    fused model's ``hbm_bytes_saved_vs_two_pass`` is exactly the
+    round-trip delta by construction (same tiles on both sides)."""
+    base = _streaming_cost(qb, b, a, kc, kernel=kernel)
+    return {"flops": base["flops"],
+            "bytes_accessed": base["bytes_accessed"]
+            + 4.0 * 2.0 * qb * b}
 
 
 def fused_dist_segmin_cost(qb: int, b: int, a: int) -> Dict[str, float]:
@@ -136,6 +227,17 @@ def _extract_entry(specs, statics) -> Optional[Dict[str, float]]:
     return extract_topk_cost(qb, b, a, kc)
 
 
+def _fused_entry(specs, statics) -> Optional[Dict[str, float]]:
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves(specs)
+        (qb, a), (b, _) = leaves[0].shape, leaves[1].shape
+        kc = int(statics["kc"])
+    except Exception:
+        return None
+    return fused_topk_cost(qb, b, a, kc)
+
+
 def _segmin_entry(specs, statics) -> Optional[Dict[str, float]]:
     del statics
     try:
@@ -153,9 +255,11 @@ def analytic_cost(fn, specs, statics: Optional[dict] = None
     recorded shape specs, or None when ``fn`` has no model (the caller
     then falls through to XLA cost analysis). Never raises."""
     try:
-        from dmlp_tpu.ops import pallas_distance, pallas_extract
+        from dmlp_tpu.ops import pallas_distance, pallas_extract, \
+            pallas_fused
         models = {
             id(pallas_extract.extract_topk): _extract_entry,
+            id(pallas_fused.fused_topk): _fused_entry,
             id(pallas_distance.fused_dist_segmin): _segmin_entry,
         }
         entry = models.get(id(fn))

@@ -169,7 +169,8 @@ def supports(qb: int, b: int, a: int, kc: int) -> bool:
 
 def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, f_ref, cd_ref, ci_ref,
             od_ref, oi_ref, it_ref, dist_s, *, kc: int, fresh: bool, ne: int,
-            unroll: int = 1, block_skip: bool = True):
+            unroll: int = 1, block_skip: bool = True,
+            mxu_gate: bool = False):
     j = pl.program_id(1)
     nj = pl.num_programs(1)
     tq, tn = dist_s.shape
@@ -178,40 +179,111 @@ def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, f_ref, cd_ref, ci_ref,
     # Mosaic kernel once per chunk — id_base differs every chunk).
     n_real = sc_ref[0, 0]
     id_base = sc_ref[0, 1]
-
-    # HIGHEST precision: default truncates f32 to bf16 on the MXU (1e-2
-    # relative distance error measured on v5e — breaks neighbor selection).
-    cross = jax.lax.dot_general(
-        q_ref[:], d_ref[:], (((1,), (1,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32)
-    dist = qn_ref[:] + dn_ref[:] - 2.0 * cross
-    dist = jnp.maximum(dist, 0.0)
-    # Per-row floor (multi-pass extraction, engine.single
-    # ._solve_extract_multipass): candidates strictly below the floor were
-    # captured by an earlier pass — mask them so this pass extracts the
-    # NEXT kc-wide slab. Single-pass callers pass -inf (no-op).
-    dist = jnp.where(dist < f_ref[:], jnp.inf, dist)
     lane = jax.lax.broadcasted_iota(jnp.int32, (tq, tn), 1)
-    pos = j * tn + lane
-    dist = jnp.where(pos >= n_real, jnp.inf, dist)
 
-    if fresh:
-        # First block seeds the running list with its first kc columns
-        # (cheaper than extracting kc entries one loop pass at a time).
-        @pl.when(j == 0)
-        def _():
-            od_ref[:] = jax.lax.slice(dist, (0, 0), (tq, kc))
-            kpos = jax.lax.broadcasted_iota(jnp.int32, tq_kc, 1)
-            oi_ref[:] = jnp.where(kpos < n_real, id_base + kpos, -1)
-        dist = jnp.where((j == 0) & (lane < kc), jnp.inf, dist)
+    gate_on = None
+    if not mxu_gate:
+        # HIGHEST precision: default truncates f32 to bf16 on the MXU (1e-2
+        # relative distance error measured on v5e — breaks neighbor
+        # selection).
+        cross = jax.lax.dot_general(
+            q_ref[:], d_ref[:], (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+        dist = qn_ref[:] + dn_ref[:] - 2.0 * cross
+        dist = jnp.maximum(dist, 0.0)
+        # Per-row floor (multi-pass extraction, engine.single
+        # ._solve_extract_multipass): candidates strictly below the floor
+        # were captured by an earlier pass — mask them so this pass
+        # extracts the NEXT kc-wide slab. Single-pass callers pass -inf
+        # (no-op).
+        dist = jnp.where(dist < f_ref[:], jnp.inf, dist)
+        pos = j * tn + lane
+        dist = jnp.where(pos >= n_real, jnp.inf, dist)
+
+        if fresh:
+            # First block seeds the running list with its first kc columns
+            # (cheaper than extracting kc entries one loop pass at a time).
+            @pl.when(j == 0)
+            def _():
+                od_ref[:] = jax.lax.slice(dist, (0, 0), (tq, kc))
+                kpos = jax.lax.broadcasted_iota(jnp.int32, tq_kc, 1)
+                oi_ref[:] = jnp.where(kpos < n_real, id_base + kpos, -1)
+            dist = jnp.where((j == 0) & (lane < kc), jnp.inf, dist)
+        else:
+            @pl.when(j == 0)
+            def _():
+                od_ref[:] = cd_ref[:]
+                oi_ref[:] = ci_ref[:]
+
+        dist_s[:] = dist
     else:
-        @pl.when(j == 0)
-        def _():
-            od_ref[:] = cd_ref[:]
-            oi_ref[:] = ci_ref[:]
+        # Fused streaming megakernel (ops.pallas_fused): the current
+        # k-th-best thresholds gate the MXU TILE itself, not just the
+        # extraction scan. A sound per-row lower bound on every distance
+        # in the block needs only the norms already streamed in:
+        # |q - d|^2 >= (|q| - |d|)^2, minimized over the block's real
+        # |d| range [mn, mx] — zero when |q| falls inside it. The bound
+        # is deflated by the engines' staging-eps cancellation margin
+        # (engine.finalize.staging_eps, same constants) so f32 rounding
+        # in the norm-expansion distance can never make a computed
+        # distance fall below it: a gated-out block is exactly a block
+        # whose extraction would have inserted nothing, and the kernel
+        # skips the matmul, the scan, and the scratch store outright
+        # (0 recorded iterations) — block skipping made free.
+        if not fresh:
+            @pl.when(j == 0)
+            def _():
+                od_ref[:] = cd_ref[:]
+                oi_ref[:] = ci_ref[:]
+        from dmlp_tpu.engine.finalize import EPS_CANCEL_COEF, EPS_REL_F32
+        na = q_ref.shape[1]
+        lane1 = jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1)
+        real = (j * tn + lane1) < n_real
+        dn = dn_ref[:]
+        sdn = jnp.sqrt(jnp.maximum(dn, 0.0))
+        mn = jnp.min(jnp.where(real, sdn, jnp.inf))
+        mx = jnp.max(jnp.where(real, sdn, -jnp.inf))
+        dn_hi = jnp.max(jnp.where(real, dn, 0.0))
+        qn = qn_ref[:]
+        sq = jnp.sqrt(jnp.maximum(qn, 0.0))
+        gap = jnp.maximum(jnp.maximum(mn - sq, sq - mx), 0.0)
+        lb = gap * gap                                     # (tq, 1)
+        scale = jnp.maximum(qn, 0.0) + dn_hi
+        eps = (EPS_REL_F32 * jnp.sqrt(lb * scale)
+               + EPS_CANCEL_COEF * (na + 2) * scale)
+        # All-sentinel blocks drive lb (and hence eps) to +inf; the
+        # inf - inf NaN compares False below, which IS the correct skip.
+        lb_safe = jnp.maximum(lb - eps, 0.0)
+        t_cur = jnp.max(od_ref[:], axis=1, keepdims=True)  # (tq, 1)
+        gate_on = jnp.max((lb_safe < t_cur).astype(jnp.int32)) > 0
+        if fresh:
+            # The first block must compute: it seeds the running lists
+            # (and od_ref holds garbage before that, making t_cur
+            # meaningless — forced on, its value never matters).
+            gate_on = gate_on | (j == 0)
 
-    dist_s[:] = dist
+        @pl.when(gate_on)
+        def _():
+            cross = jax.lax.dot_general(
+                q_ref[:], d_ref[:], (((1,), (1,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32)
+            dist = qn_ref[:] + dn_ref[:] - 2.0 * cross
+            dist = jnp.maximum(dist, 0.0)
+            dist = jnp.where(dist < f_ref[:], jnp.inf, dist)
+            pos = j * tn + lane
+            dist = jnp.where(pos >= n_real, jnp.inf, dist)
+            dist_s[:] = dist
+
+        if fresh:
+            @pl.when(j == 0)
+            def _():
+                d0 = dist_s[:]
+                od_ref[:] = jax.lax.slice(d0, (0, 0), (tq, kc))
+                kpos = jax.lax.broadcasted_iota(jnp.int32, tq_kc, 1)
+                oi_ref[:] = jnp.where(kpos < n_real, id_base + kpos, -1)
+                dist_s[:] = jnp.where(lane < kc, jnp.inf, d0)
 
     kiota = jax.lax.broadcasted_iota(jnp.int32, tq_kc, 1)
     w = tn // ne
@@ -264,10 +336,16 @@ def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, f_ref, cd_ref, ci_ref,
         # and the no-improve cost drops from a full ne-pass round to
         # this one reduction.
         t0 = jnp.max(od_ref[:], axis=1, keepdims=True)      # (tq, 1)
-        bmin = jnp.min(dist, axis=1, keepdims=True)         # (tq, 1)
+        # The MXU-gated kernel has no local `dist` value (the compute is
+        # predicated); read the scratch it conditionally stored — stale
+        # contents when the gate fired are masked out by the AND below.
+        bmin = jnp.min(dist_s[:] if mxu_gate else dist, axis=1,
+                       keepdims=True)                       # (tq, 1)
         go0 = jnp.max((bmin < t0).astype(jnp.int32)) > 0
     else:
         go0 = True
+    if gate_on is not None:
+        go0 = gate_on & go0
     iters, _ = jax.lax.while_loop(
         lambda s: s[1] & (s[0] <= tn), body, (jnp.int32(0), go0))
     # Diagnostic loop counts: lane j of this tile's block (row 0 is read
@@ -292,7 +370,7 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
                  id_base=0, kc: int, interpret: bool = False,
                  tile_q: int | None = None, tile_n: int | None = None,
                  ne: int | None = None, unroll: int | None = None,
-                 block_skip: bool = True,
+                 block_skip: bool = True, mxu_gate: bool = False,
                  floor: jax.Array | None = None):
     """(queries (Qb, A), data (B, A)) -> (dists (Qb, kc) f32 ascending-ish
     unsorted, ids (Qb, kc) i32, iters (Qb/tq, B/tn) i32 loop counts; 0 =
@@ -313,7 +391,10 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
     of silently reusing a trace baked with the old variant.
     ``block_skip`` toggles the threshold-gated block prefilter
     (output-identical either way; off only for A/B measurement,
-    tools/roofline_extract.py).
+    tools/roofline_extract.py). ``mxu_gate`` enables the fused
+    megakernel's norm-bound MXU tile gating (output-identical;
+    ops.pallas_fused.fused_topk is the public face, which also resolves
+    variants from the fused tune-cache namespace).
 
     Gate on supports() first. Output lists are NOT sorted; callers sort by
     the composite key (ops.topk.select_topk) if order matters.
@@ -337,16 +418,16 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
         tile_n=v.get("tile_n", _TN) if tile_n is None else tile_n,
         ne=v["ne"] if ne is None else ne,
         unroll=v["unroll"] if unroll is None else unroll,
-        block_skip=block_skip, floor=floor)
+        block_skip=block_skip, mxu_gate=mxu_gate, floor=floor)
 
 
 @functools.partial(
     jax.jit, static_argnames=("kc", "interpret", "tile_q", "tile_n", "ne",
-                              "unroll", "block_skip"))
+                              "unroll", "block_skip", "mxu_gate"))
 def _extract_topk_jit(q_attrs, d_attrs, carry_d, carry_i, *, n_real,
                       id_base, kc: int, interpret: bool, tile_q: int,
                       tile_n: int, ne: int, unroll: int, block_skip: bool,
-                      floor):
+                      mxu_gate: bool, floor):
     qb, a = q_attrs.shape
     b = d_attrs.shape[0]
     tq = _tile(qb, tile_q, 8)
@@ -377,7 +458,8 @@ def _extract_topk_jit(q_attrs, d_attrs, carry_d, carry_i, *, n_real,
     scalars = jnp.asarray([[n_real, id_base]], jnp.int32)     # (1, 2) SMEM
     grid = (qb // tq, b // tn)
     kern = functools.partial(_kernel, kc=kc, fresh=fresh, ne=ne,
-                             unroll=unroll, block_skip=block_skip)
+                             unroll=unroll, block_skip=block_skip,
+                             mxu_gate=mxu_gate)
     out_d, out_i, out_iters = pl.pallas_call(
         kern,
         grid=grid,

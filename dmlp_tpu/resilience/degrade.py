@@ -5,17 +5,25 @@ fault injector, or a real XLA OOM — resilience.retry.classify treats
 them identically) the single-chip solve steps DOWN a ladder instead of
 crashing, and every rung preserves the contract checksums exactly:
 
-1. ``tuned``      — the normal path: extraction kernel with the
-                    autotuner's cached variant (dmlp_tpu.tune).
-2. ``heuristic``  — the extraction kernel with the heuristic variant
+1. ``fused``      — the normal path: the fused distance→top-k streaming
+                    megakernel (ops.pallas_fused) where its supports()
+                    holds, two-pass extraction otherwise. The
+                    ``DMLP_TPU_FUSED=0`` kill switch (mirroring
+                    ``DMLP_TPU_RESILIENCE``) pins this rung to the
+                    two-pass kernel without consuming a ladder step.
+2. ``tuned``      — the two-pass extraction kernel with the autotuner's
+                    cached variant (dmlp_tpu.tune): the fused kernel's
+                    (identical-size, but separately-tuned) tiles are
+                    the first thing to give back on a fused-path OOM.
+3. ``heuristic``  — the extraction kernel with the heuristic variant
                     (tune-cache lookups suppressed): a swept variant's
-                    larger tiles are the first allocation to give back;
+                    larger tiles are the next allocation to give back;
                     results are bit-identical by the PR 3 contract.
-3. ``streaming``  — the chunked multipass streaming fold
+4. ``streaming``  — the chunked multipass streaming fold
                     (engine.single._solve_pipelined): no running-list
                     kernel state, the live tile shrinks to one
                     (query_block x chunk) slab.
-4. ``host``       — the float64 golden solve on the host
+5. ``host``       — the float64 golden solve on the host
                     (golden.fast.knn_golden_fast): zero device memory;
                     it IS the oracle the contract diffs against, so
                     byte-identity is by construction.
@@ -33,16 +41,18 @@ from typing import Callable, List
 from dmlp_tpu.resilience import stats
 from dmlp_tpu.resilience.retry import classify, resilience_enabled
 
-RUNGS = ("tuned", "heuristic", "streaming", "host")
+RUNGS = ("fused", "tuned", "heuristic", "streaming", "host")
 
 
 @contextlib.contextmanager
 def _rung_context(engine, rung: str):
     """Configure the engine for one rung. ``_degrade_rung`` is consulted
     by engine.single._solve/_solve_segments (``streaming`` skips every
-    extract-kernel path); ``heuristic`` suppresses autotuner cache
-    lookups for the duration."""
-    prev = getattr(engine, "_degrade_rung", "tuned")
+    extract-kernel path) and by ops.pallas_fused.resolve_topk_kernel
+    (only the ``fused`` rung may dispatch the fused megakernel);
+    ``heuristic`` suppresses autotuner cache lookups for the
+    duration."""
+    prev = getattr(engine, "_degrade_rung", "fused")
     engine._degrade_rung = rung
     try:
         if rung == "heuristic":

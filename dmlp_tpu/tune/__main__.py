@@ -9,14 +9,17 @@ Regenerate the variant cache on the current backend::
 The sweep measures at the CHUNKED dispatch shape the engines actually
 use (plan_chunks on the extract granule) and merges winners into the
 cache file (``$DMLP_TPU_TUNE_CACHE`` or
-``~/.cache/dmlp_tpu/extract_variants.json``) keyed by (device kind,
-data-rows bucket, kc, dtype). Existing entries for other keys are kept.
+``~/.cache/dmlp_tpu/extract_variants.json``) keyed by (kernel, device
+kind, data-rows bucket, kc, dtype). Existing entries for other keys are
+kept. ``--kernel extract|fused|both`` (default both) picks which
+kernel's variant space to sweep — the fused megakernel
+(ops.pallas_fused) caches under its own namespace.
 
 ``--smoke`` runs a tiny-shape sweep (CPU interpret mode works) over a
-4-variant slice — the ``make tune-smoke`` CI gate that proves the
-measure -> pick -> persist -> reload pipeline and validates the cache
-schema end-to-end. ``--validate PATH`` just schema-checks an existing
-cache file and exits.
+4-variant slice PER KERNEL — the ``make tune-smoke`` CI gate that
+proves the measure -> pick -> persist -> reload pipeline (fused sweep
+included) and validates the cache schema end-to-end. ``--validate
+PATH`` just schema-checks an existing cache file and exits.
 """
 
 from __future__ import annotations
@@ -38,6 +41,11 @@ def main(argv=None) -> int:
                     help="candidate-list width to tune directly "
                          "(repeatable; overrides --k derivation)")
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--kernel", choices=("extract", "fused", "both"),
+                    default="both",
+                    help="which kernel's variant space to sweep (the "
+                         "fused megakernel caches under its own "
+                         "namespace)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None,
                     help="cache file (default: the lookup path — "
@@ -90,11 +98,17 @@ def main(argv=None) -> int:
                           for k in ks})
 
     out_path = args.out or cache_path()
-    print(f"tune: sweeping extract variants at n={n} q={nq} a={a} "
-          f"kcs={kcs} reps={reps} -> {out_path}", flush=True)
+    kernels = ("extract", "fused") if args.kernel == "both" \
+        else (args.kernel,)
+    print(f"tune: sweeping {'+'.join(kernels)} variants at n={n} q={nq} "
+          f"a={a} kcs={kcs} reps={reps} -> {out_path}", flush=True)
     kwargs = {} if space_fn is None else {"space_fn": space_fn}
-    winners, rows = sweep_extract(n, nq, a, kcs, reps=reps,
-                                  seed=args.seed, out=sys.stdout, **kwargs)
+    winners, rows = [], []
+    for kern in kernels:
+        w, r = sweep_extract(n, nq, a, kcs, reps=reps, seed=args.seed,
+                             out=sys.stdout, kernel=kern, **kwargs)
+        winners += w
+        rows += r
     if not winners:
         print("tune: FAIL — no variant measured for any kc",
               file=sys.stderr)
@@ -111,7 +125,10 @@ def main(argv=None) -> int:
         cache = VariantCache()  # unreadable/stale-schema file: rebuild
     for w in winners:
         cache.put(kind, w["b"], w["kc"], w["variant"], a=a,
-                  dtype="float32", measured_ms=w["measured_ms"],
+                  dtype="float32",
+                  kernel="fused_topk" if w["kernel"] == "fused"
+                  else "extract_topk",
+                  measured_ms=w["measured_ms"],
                   swept=w["swept"], shape=(w["qb"], w["b"], a))
     cache.save(out_path)
     clear_lookup_memo()  # this process sees its own fresh winners
@@ -128,8 +145,8 @@ def main(argv=None) -> int:
 
     print(json.dumps({"device_kind": kind, "cache": out_path,
                       "entries": len(cache.entries),
-                      "winners": [{"kc": w["kc"], "b": w["b"],
-                                   "variant": w["variant"]}
+                      "winners": [{"kernel": w["kernel"], "kc": w["kc"],
+                                   "b": w["b"], "variant": w["variant"]}
                                   for w in winners]}))
     return 0
 

@@ -1,10 +1,15 @@
 """Persisted variant cache for the measured autotuner.
 
-One small versioned JSON file maps (device kind, data-rows shape bucket,
-kc, dtype) -> the fastest measured extract-kernel variant. The file is
+One small versioned JSON file maps (kernel, device kind, data-rows shape
+bucket, kc, dtype) -> the fastest measured kernel variant. The file is
 written by the sweep (``python -m dmlp_tpu.tune``) and read on the hot
-path by ``ops.pallas_extract._resolve_variant`` through
-:func:`lookup_variant`.
+path by ``ops.pallas_extract._resolve_variant`` (kernel "extract_topk")
+and ``ops.pallas_fused._resolve_variant`` (kernel "fused_topk") through
+:func:`lookup_variant`. Schema 2 added the per-entry kernel namespace:
+the fused megakernel's MXU gate shifts which tiles win, so the two
+kernels sweep and cache independently; schema-1 files (extract-only)
+still LOAD — their keys upgrade to the extract namespace in memory —
+but saves always write schema 2.
 
 Design constraints, in order:
 
@@ -36,10 +41,16 @@ import os
 import time
 from typing import Any, Dict, Optional, Tuple
 
-#: bump on any backward-incompatible cache field change
-CACHE_SCHEMA = 1
+#: bump on any backward-incompatible cache field change (2: per-entry
+#: kernel namespace — extract_topk vs the fused megakernel)
+CACHE_SCHEMA = 2
 
-_KERNEL = "extract_topk"
+#: the schema-2 envelope family; per-entry keys carry the concrete kernel
+_KERNEL_FAMILY = "pallas_topk"
+#: legal per-entry kernel namespaces
+_KERNELS = ("extract_topk", "fused_topk")
+#: the schema-1 envelope value (extract-only caches; lenient load)
+_KERNEL_V1 = "extract_topk"
 
 #: legal extraction-candidates-per-pass values (quarter layout: ne must
 #: divide the block into whole 128-lane sub-blocks)
@@ -64,9 +75,9 @@ def shape_bucket(b: int) -> int:
     return 1 << (b - 1).bit_length()
 
 
-def _key(device_kind: str, b_bucket: int, a_bucket: int, kc: int,
-         dtype: str) -> str:
-    return f"{device_kind}|b{b_bucket}|a{a_bucket}|kc{kc}|{dtype}"
+def _key(kernel: str, device_kind: str, b_bucket: int, a_bucket: int,
+         kc: int, dtype: str) -> str:
+    return f"{kernel}|{device_kind}|b{b_bucket}|a{a_bucket}|kc{kc}|{dtype}"
 
 
 def validate_variant(v: Any) -> bool:
@@ -115,20 +126,23 @@ class VariantCache:
     # -- mutation ------------------------------------------------------------
     def put(self, device_kind: str, b: int, kc: int, variant: Dict, *,
             a: int, dtype: str = "float32",
+            kernel: str = "extract_topk",
             measured_ms: Optional[float] = None,
             swept: Optional[int] = None,
             shape: Optional[Tuple[int, int, int]] = None) -> str:
-        """Record the winning ``variant`` for (device, bucket(b),
+        """Record the winning ``variant`` for (kernel, device, bucket(b),
         bucket(a), kc, dtype); returns the entry key. ``a`` (the swept
         attribute width) is part of the key: the VMEM footprint — and
         hence which variants even fit — scales with it. Raises
         ValueError on a variant that fails structural validation — a
         sweep must never persist a variant the hot path would have to
-        reject."""
+        reject — or on an unknown kernel namespace."""
         if not validate_variant(variant):
             raise ValueError(f"invalid variant {variant!r}")
-        key = _key(device_kind, shape_bucket(b), shape_bucket(a), kc,
-                   dtype)
+        if kernel not in _KERNELS:
+            raise ValueError(f"unknown kernel namespace {kernel!r}")
+        key = _key(kernel, device_kind, shape_bucket(b), shape_bucket(a),
+                   kc, dtype)
         entry: Dict[str, Any] = {"variant": dict(variant),
                                  "created_unix": time.time()}
         if measured_ms is not None:
@@ -142,13 +156,14 @@ class VariantCache:
 
     # -- read ----------------------------------------------------------------
     def get(self, device_kind: str, b: int, kc: int, *, a: int,
-            dtype: str = "float32") -> Optional[Dict]:
-        """The cached variant for (device, bucket(b), bucket(a), kc,
-        dtype), after per-entry validation and the per-dispatch
+            dtype: str = "float32",
+            kernel: str = "extract_topk") -> Optional[Dict]:
+        """The cached variant for (kernel, device, bucket(b), bucket(a),
+        kc, dtype), after per-entry validation and the per-dispatch
         alignment gate — None on miss, corrupt entry, or misfit."""
         e = self.entries.get(
-            _key(device_kind, shape_bucket(b), shape_bucket(a), kc,
-                 dtype))
+            _key(kernel, device_kind, shape_bucket(b), shape_bucket(a),
+                 kc, dtype))
         if not isinstance(e, dict):
             return None
         v = e.get("variant")
@@ -158,7 +173,7 @@ class VariantCache:
 
     # -- persistence ---------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
-        return {"schema": CACHE_SCHEMA, "kernel": _KERNEL,
+        return {"schema": CACHE_SCHEMA, "kernel": _KERNEL_FAMILY,
                 "created_unix": self.created_unix, "entries": self.entries}
 
     def save(self, path: Optional[str] = None) -> str:
@@ -176,20 +191,27 @@ class VariantCache:
     @staticmethod
     def validate_doc(doc: Any) -> None:
         """Raise ValueError naming the first schema violation (the
-        tune-smoke CI gate calls this on the file it just wrote)."""
+        tune-smoke CI gate calls this on the file it just wrote).
+        Accepts schema 2 (kernel-namespaced keys) and grandfathered
+        schema-1 extract-only files."""
         if not isinstance(doc, dict):
             raise ValueError("cache is not a JSON object")
         schema = doc.get("schema")
-        if schema != CACHE_SCHEMA:
-            raise ValueError(f"cache schema {schema!r} != {CACHE_SCHEMA} "
+        if schema not in (1, CACHE_SCHEMA):
+            raise ValueError(f"cache schema {schema!r} not in "
+                             f"(1, {CACHE_SCHEMA}) "
                              "(regenerate with python -m dmlp_tpu.tune)")
-        if doc.get("kernel") != _KERNEL:
+        want_kernel = _KERNEL_V1 if schema == 1 else _KERNEL_FAMILY
+        if doc.get("kernel") != want_kernel:
             raise ValueError(f"cache kernel {doc.get('kernel')!r} != "
-                             f"{_KERNEL!r}")
+                             f"{want_kernel!r}")
         entries = doc.get("entries")
         if not isinstance(entries, dict):
             raise ValueError("cache entries block missing or not a dict")
         for key, e in entries.items():
+            if schema == CACHE_SCHEMA \
+                    and key.split("|", 1)[0] not in _KERNELS:
+                raise ValueError(f"entry {key!r} has no kernel namespace")
             if not isinstance(e, dict) or not validate_variant(
                     e.get("variant")):
                 raise ValueError(f"entry {key!r} carries an invalid "
@@ -201,17 +223,28 @@ class VariantCache:
         shape) — raises on an unreadable or wrong-schema file, but a
         single corrupt ENTRY does not poison the rest: per-entry
         validation happens at ``get()``, so the file's other winners
-        stay live. The strict whole-file check (every entry valid) is
-        :meth:`validate_doc` — the ``--validate`` CI gate."""
+        stay live. Schema-1 files (extract-only, pre-fused) load
+        LENIENTLY: their keys upgrade to the extract_topk namespace in
+        memory, so a tuned machine keeps its winners across the bump
+        (the next sweep re-saves as schema 2). The strict whole-file
+        check (every entry valid) is :meth:`validate_doc` — the
+        ``--validate`` CI gate."""
         path = path or cache_path()
         with open(path) as f:
             doc = json.load(f)
+        if isinstance(doc, dict) and doc.get("schema") == 1 \
+                and doc.get("kernel") == _KERNEL_V1 \
+                and isinstance(doc.get("entries"), dict):
+            entries = {f"{_KERNEL_V1}|{k}": e
+                       for k, e in doc["entries"].items()}
+            return cls(entries=entries,
+                       created_unix=doc.get("created_unix"))
         if not isinstance(doc, dict) or doc.get("schema") != CACHE_SCHEMA \
-                or doc.get("kernel") != _KERNEL \
+                or doc.get("kernel") != _KERNEL_FAMILY \
                 or not isinstance(doc.get("entries"), dict):
             raise ValueError(
-                f"{path}: not a schema-{CACHE_SCHEMA} {_KERNEL} variant "
-                "cache (regenerate with python -m dmlp_tpu.tune)")
+                f"{path}: not a schema-{CACHE_SCHEMA} {_KERNEL_FAMILY} "
+                "variant cache (regenerate with python -m dmlp_tpu.tune)")
         return cls(entries=doc["entries"],
                    created_unix=doc.get("created_unix"))
 
@@ -264,15 +297,18 @@ def _current_device_kind() -> str:
 def lookup_variant(kc: int, b: int, a: Optional[int] = None,
                    dtype: str = "float32",
                    device_kind: Optional[str] = None,
-                   path: Optional[str] = None) -> Optional[Dict]:
+                   path: Optional[str] = None,
+                   kernel: str = "extract_topk") -> Optional[Dict]:
     """The hot-path read: cached variant for this dispatch, or None.
 
-    Never raises; returns None when ``a`` is unknown (the attribute
-    width is part of the key — every real dispatch site knows it), the
-    cache file is absent, unreadable, schema-invalid, keyed for a
-    different device kind, the matched entry is corrupt, or its variant
-    cannot tile this ``b`` (alignment rejection) — the caller then uses
-    the deterministic heuristic."""
+    ``kernel`` selects the namespace ("extract_topk" | "fused_topk" —
+    the fused megakernel sweeps and caches separately). Never raises;
+    returns None when ``a`` is unknown (the attribute width is part of
+    the key — every real dispatch site knows it), the cache file is
+    absent, unreadable, schema-invalid, keyed for a different device
+    kind, the matched entry is corrupt, or its variant cannot tile this
+    ``b`` (alignment rejection) — the caller then uses the
+    deterministic heuristic."""
     if _suppress_depth or a is None:
         return None
     path = path or cache_path()
@@ -289,4 +325,4 @@ def lookup_variant(kc: int, b: int, a: Optional[int] = None,
         return None
     if device_kind is None:
         device_kind = _current_device_kind()
-    return cache.get(device_kind, b, kc, a=a, dtype=dtype)
+    return cache.get(device_kind, b, kc, a=a, dtype=dtype, kernel=kernel)

@@ -1,12 +1,16 @@
-"""Fenced measured sweep over the extract-kernel variant space.
+"""Fenced measured sweep over the extract/fused kernel variant spaces.
 
-For each requested (shape, kc) the sweep enumerates every variant the
-kernel can actually tile — ``tile_q`` x ``tile_n`` x ``ne`` x ``unroll``,
-gated by ``ops.pallas_extract.variant_supports`` so the sweep can never
-persist a variant the hot path would reject — times each with the
-dependent-readback fence the bench tools share (block_until_ready is
-unreliable over tunneled PJRT links), and records the winner in the
-variant cache (:mod:`dmlp_tpu.tune.cache`).
+For each requested (kernel, shape, kc) the sweep enumerates every
+variant the kernel can actually tile — ``tile_q`` x ``tile_n`` x ``ne``
+x ``unroll``, gated by ``ops.pallas_extract.variant_supports`` so the
+sweep can never persist a variant the hot path would reject — times each
+with the dependent-readback fence the bench tools share
+(block_until_ready is unreliable over tunneled PJRT links), and records
+the winner in the variant cache (:mod:`dmlp_tpu.tune.cache`) under that
+kernel's namespace. The fused megakernel (ops.pallas_fused) shares the
+tile space but sweeps separately: its MXU gate turns warm no-improve
+blocks into one VPU bound pass, which shifts the block-size trade-off
+the winner encodes.
 
 Two honesty rules carried over from the bench methodology:
 
@@ -102,20 +106,23 @@ def _fenced_ms(fn, q, d, reps: int) -> float:
 
 
 def time_variant_ms(q, d, n_real: int, kc: int, v: Dict, reps: int,
-                    interpret: bool, warm_folds: int = 1) -> float:
-    """Fenced time of one extract_topk variant at the staged arrays:
-    one FRESH dispatch plus ``warm_folds`` carry folds over the same
-    block. The engines' hot path is a chunk chain — one cold fold, then
-    warm folds where the running lists gate most blocks out (the block
-    skip's whole win) — so ranking variants on the cold dispatch alone
-    would pick winners at an operating point the chain mostly doesn't
-    run; the 1-cold + 1-warm chain weights both regimes. Raises
-    whatever the compile raises — the sweep catches and skips."""
+                    interpret: bool, warm_folds: int = 1,
+                    kernel: str = "extract") -> float:
+    """Fenced time of one kernel variant at the staged arrays: one FRESH
+    dispatch plus ``warm_folds`` carry folds over the same block. The
+    engines' hot path is a chunk chain — one cold fold, then warm folds
+    where the running lists gate most blocks out (the block skip's —
+    and for ``kernel="fused"``, the MXU gate's — whole win) — so
+    ranking variants on the cold dispatch alone would pick winners at
+    an operating point the chain mostly doesn't run; the 1-cold +
+    1-warm chain weights both regimes. Raises whatever the compile
+    raises — the sweep catches and skips."""
     from dmlp_tpu.ops.pallas_extract import extract_topk
 
     b = d.shape[0]
     kw = dict(kc=kc, interpret=interpret, tile_q=v["tile_q"],
-              tile_n=v["tile_n"], ne=v["ne"], unroll=v["unroll"])
+              tile_n=v["tile_n"], ne=v["ne"], unroll=v["unroll"],
+              mxu_gate=kernel == "fused")
 
     def fn(q_, d_):
         od, oi, _it = extract_topk(q_, d_, n_real=n_real, **kw)
@@ -129,6 +136,7 @@ def time_variant_ms(q, d, n_real: int, kc: int, v: Dict, reps: int,
 def sweep_extract(n: int, nq: int, a: int, kcs: Sequence[int],
                   reps: int = 3, seed: int = 0,
                   space_fn=variant_space, out=None,
+                  kernel: str = "extract",
                   ) -> Tuple[List[Dict], List[Dict]]:
     """Measure the variant space at BOTH dispatch shapes the engines use
     for an (n, nq, a) workload and return (winners, detail rows).
@@ -144,8 +152,10 @@ def sweep_extract(n: int, nq: int, a: int, kcs: Sequence[int],
       dispatch in a bucket the sweep never keyed and silently fall
       back to the heuristic.
 
-    Queries pad to whole query tiles. ``winners`` is a list of
-    {"kc", "b", "qb", "variant", "measured_ms", "swept",
+    Queries pad to whole query tiles. ``kernel`` ("extract" | "fused")
+    selects which kernel the variants drive; winners persist under that
+    kernel's cache namespace. ``winners`` is a list of
+    {"kernel", "kc", "b", "qb", "variant", "measured_ms", "swept",
     "skipped_compile", "kc_pad_probe_ms"?} records — one per
     (kc, b point) that measured at least one variant.
     """
@@ -180,33 +190,34 @@ def sweep_extract(n: int, nq: int, a: int, kcs: Sequence[int],
             for v in space:
                 try:
                     ms = time_variant_ms(q, d, n_real, kc, v, reps,
-                                         interpret)
+                                         interpret, kernel=kernel)
                 except Exception as e:  # Mosaic tiling edge: skip, count
                     skipped += 1
-                    rows.append({"kc": kc, "b": b, "variant": v,
-                                 "error": str(e)[:200]})
+                    rows.append({"kernel": kernel, "kc": kc, "b": b,
+                                 "variant": v, "error": str(e)[:200]})
                     continue
-                rows.append({"kc": kc, "b": b, "variant": v,
-                             "ms": round(ms, 3)})
-                log(f"  b={b} kc={kc} {v} -> {ms:.2f} ms")
+                rows.append({"kernel": kernel, "kc": kc, "b": b,
+                             "variant": v, "ms": round(ms, 3)})
+                log(f"  {kernel} b={b} kc={kc} {v} -> {ms:.2f} ms")
                 if ms < best_ms:
                     best, best_ms = v, ms
             if best is None:
-                log(f"  b={b} kc={kc}: no variant measured "
+                log(f"  {kernel} b={b} kc={kc}: no variant measured "
                     f"({skipped} compile-skipped of {len(space)})")
                 continue
-            entry = {"kc": kc, "b": b, "qb": qpad, "variant": best,
-                     "measured_ms": best_ms,
+            entry = {"kernel": kernel, "kc": kc, "b": b, "qb": qpad,
+                     "variant": best, "measured_ms": best_ms,
                      "swept": len(space) - skipped,
                      "skipped_compile": skipped}
             # kc-padding probe: the winner at kc+8 — informational only.
             try:
                 entry["kc_pad_probe_ms"] = round(
                     time_variant_ms(q, d, n_real, kc + 8, best, reps,
-                                    interpret), 3)
+                                    interpret, kernel=kernel), 3)
             except Exception:
                 pass
             winners.append(entry)
-            log(f"  b={b} kc={kc}: winner {best} at {best_ms:.2f} ms "
+            log(f"  {kernel} b={b} kc={kc}: winner {best} at "
+                f"{best_ms:.2f} ms "
                 f"({entry['swept']} measured, {skipped} skipped)")
     return winners, rows

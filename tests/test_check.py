@@ -155,11 +155,177 @@ class TestR1Collectives:
 
 
 # ---------------------------------------------------------------------------
+# R105/R106 — kernel-dispatch cost coverage (R1 family)
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchCost:
+    def test_r105_dispatch_without_probe(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from dmlp_tpu.obs import counters as obs_counters
+            from dmlp_tpu.ops.pallas_fused import fused_topk
+
+            def drive(q, d):
+                obs_counters.record_dispatch(fused_topk, (q, d), site="s")
+                return fused_topk(q, d, n_real=4, kc=8)
+        """)
+        fs = run_check(tmp_path, ["R1"])
+        assert "R105" in rules_of(fs)
+        assert any("MeasuredIters" in f.message for f in fs)
+
+    def test_r105_resolver_bound_kernel_var_covered(self, tmp_path):
+        """``kern, impl = resolve_topk_kernel(...)`` binds a kernel
+        variable — dispatching it without a probe is the same hole."""
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from dmlp_tpu.obs import counters as obs_counters
+            from dmlp_tpu.ops import pallas_fused
+
+            def drive(q, d):
+                kern, impl = pallas_fused.resolve_topk_kernel(8, 8, 8, 8)
+                obs_counters.record_dispatch(kern, (q, d), site="s")
+                return kern(q, d, n_real=4, kc=8)
+        """)
+        assert "R105" in rules_of(run_check(tmp_path, ["R1"]))
+
+    def test_r105_probe_in_function_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from dmlp_tpu.engine.single import MeasuredIters
+            from dmlp_tpu.obs import counters as obs_counters
+            from dmlp_tpu.ops.pallas_fused import fused_topk
+
+            def drive(eng, q, d):
+                mi = MeasuredIters(eng, "s", (1, 2, 3, 4),
+                                   kernel="fused")
+                obs_counters.record_dispatch(fused_topk, (q, d), site="s")
+                od, oi, it = fused_topk(q, d, n_real=4, kc=8)
+                mi.add(it)
+                mi.done()
+                return od
+        """)
+        assert run_check(tmp_path, ["R1"]) == []
+
+    def test_r105_queue_iters_protocol_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from dmlp_tpu.obs import counters as obs_counters
+            from dmlp_tpu.ops import pallas_fused
+
+            def drive(self, q, d):
+                kern, impl = pallas_fused.resolve_topk_kernel(8, 8, 8, 8)
+                obs_counters.record_dispatch(kern, (q, d), site="s")
+                od, oi, it = kern(q, d, n_real=4, kc=8)
+                self._queue_iters("s", "extract", it, 8, 8, 8, 8,
+                                  impl=impl)
+                return od
+        """)
+        assert run_check(tmp_path, ["R1"]) == []
+
+    def test_r106_unmodeled_ops_kernel(self, tmp_path):
+        """A kernel imported from dmlp_tpu.ops with no analytic_cost
+        registry entry (parsed from the REAL kernel_cost.py) fails —
+        the fused-megakernel drift class."""
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from dmlp_tpu.engine.single import MeasuredIters
+            from dmlp_tpu.obs import counters as obs_counters
+            from dmlp_tpu.ops.pallas_next import hyper_kernel
+
+            def drive(eng, q, d):
+                mi = MeasuredIters(eng, "s", (1, 2, 3, 4))
+                obs_counters.record_dispatch(hyper_kernel, (q, d),
+                                             site="s")
+                od, oi, it = hyper_kernel(q, d, n_real=4, kc=8)
+                mi.add(it)
+                mi.done()
+                return od
+        """)
+        fs = run_check(tmp_path, ["R1"])
+        assert rules_of(fs) == ["R106"]
+        assert any("hyper_kernel" in f.message for f in fs)
+
+    def test_r106_registered_kernels_clean(self, tmp_path):
+        """extract_topk and fused_topk ARE in the parsed model table —
+        this pins the registry parse itself (an empty parse would make
+        R106 fire on every legitimate dispatch or none)."""
+        from dmlp_tpu.check.analyzer import load_modules
+        from dmlp_tpu.check.dispatchcost import _modeled_kernels
+        mods, _ = load_modules([package_root()])
+        modeled = _modeled_kernels(mods)
+        assert {"extract_topk", "fused_topk",
+                "fused_dist_segmin"} <= modeled
+
+    def test_r105_allow_directive(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from dmlp_tpu.obs import counters as obs_counters
+            from dmlp_tpu.ops.pallas_fused import fused_topk
+
+            def drive(q, d):
+                # check: allow-collective
+                obs_counters.record_dispatch(fused_topk, (q, d), site="s")
+                return fused_topk(q, d, n_real=4, kc=8)
+        """)
+        assert run_check(tmp_path, ["R1"]) == []
+
+    def test_r105_outside_engine_ignored(self, tmp_path):
+        """tools/bench measure what they please — engine/ only."""
+        write(tmp_path, "dmlp_tpu/bench/x.py", """
+            from dmlp_tpu.obs import counters as obs_counters
+            from dmlp_tpu.ops.pallas_fused import fused_topk
+
+            def drive(q, d):
+                obs_counters.record_dispatch(fused_topk, (q, d), site="s")
+                return fused_topk(q, d, n_real=4, kc=8)
+        """)
+        assert run_check(tmp_path, ["R1"]) == []
+
+
+# ---------------------------------------------------------------------------
 # R2 — recompilation hazards
 # ---------------------------------------------------------------------------
 
 
 class TestR2Recompile:
+    def test_r203_fused_selection_inside_jit(self, tmp_path):
+        """ISSUE 8 small fix: the fused/two-pass selection
+        (resolve_topk_kernel, and the kill-switch read behind it) is
+        the PR 3 in-jit-resolution bug class — R203 must provably
+        cover it so the choice is always part of the jit cache key."""
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import jax
+            from dmlp_tpu.ops.pallas_fused import resolve_topk_kernel
+
+            @jax.jit
+            def solve(q, d):
+                kern, impl = resolve_topk_kernel(8, 8, 8, 8)
+                return kern(q, d, n_real=4, kc=8)
+        """)
+        fs = run_check(tmp_path, ["R2"])
+        assert "R203" in rules_of(fs)
+        assert any("resolve_topk_kernel" in f.message for f in fs)
+
+    def test_r203_fused_kill_switch_read_inside_jit(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import jax
+            from dmlp_tpu.ops.pallas_fused import fused_enabled
+
+            @jax.jit
+            def solve(q, d):
+                if fused_enabled():
+                    return q
+                return d
+        """)
+        assert "R203" in rules_of(run_check(tmp_path, ["R2"]))
+
+    def test_r203_fused_selection_outside_jit_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import functools
+            import jax
+            from dmlp_tpu.ops.pallas_fused import resolve_topk_kernel
+
+            def solve(q, d):
+                kern, impl = resolve_topk_kernel(8, 8, 8, 8)
+                run = jax.jit(functools.partial(kern, n_real=4, kc=8))
+                return run(q, d)
+        """)
+        assert "R203" not in rules_of(run_check(tmp_path, ["R2"]))
     def test_r201_mutable_default_on_jit(self, tmp_path):
         write(tmp_path, "dmlp_tpu/ops/x.py", """
             import jax

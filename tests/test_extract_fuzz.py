@@ -339,7 +339,11 @@ def test_extract_engine_tie_heavy_dup_rows_block_boundaries_vs_golden(
     path = str(tmp_path / "variants.json")
     monkeypatch.setenv("DMLP_TPU_TUNE_CACHE", path)
     cache = VariantCache()
+    # the engine prefers the fused megakernel (fused_topk namespace) —
+    # pin BOTH namespaces so the multi-block variant drives whichever
+    # kernel the dispatch resolves
     cache.put("cpu", 12800, kc, pinned, a=na)
+    cache.put("cpu", 12800, kc, pinned, a=na, kernel="fused_topk")
     cache.save(path)
     clear_lookup_memo()
     from dmlp_tpu.obs import trace as obs_trace

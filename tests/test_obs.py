@@ -403,27 +403,30 @@ def test_multipass_full_array_supports_invariant_holds_today():
 
 
 def test_multipass_guard_trips_when_full_array_untileable(monkeypatch):
-    """Regression for the new guard: if extract_supports ever rejects the
-    concatenated d_full row count while accepting the chunk size, the
-    multi-pass driver must fail loudly BEFORE dispatching passes 2+ over
-    a shape the kernel cannot tile (previously it dispatched anyway)."""
+    """Regression for the new guard: if the kernel resolution ever
+    rejects the concatenated d_full row count while accepting the chunk
+    size, the multi-pass driver must fail loudly BEFORE dispatching
+    passes 2+ over a shape no kernel can tile (previously it dispatched
+    anyway). The driver resolves fused-vs-two-pass through
+    pallas_fused.resolve_topk_kernel (ISSUE 8) — that is the seam the
+    guard actually consults, so that is what the fake rejects."""
     from dmlp_tpu.config import EngineConfig
     from dmlp_tpu.engine.single import SingleChipEngine
-    from dmlp_tpu.ops import pallas_extract
+    from dmlp_tpu.ops import pallas_fused
 
     inp = _widek_input()
     eng = SingleChipEngine(EngineConfig(use_pallas=True, select="extract"))
 
-    real = pallas_extract.supports
+    real = pallas_fused.resolve_topk_kernel
     chunk_sizes = []
 
-    def fake_supports(qb, b, a, kc):
+    def fake_resolve(qb, b, a, kc, rung="fused"):
         chunk_sizes.append(b)
         if b > 38400:        # the full concatenated array — reject it
-            return False
-        return real(qb, b, a, kc)
+            return None, None
+        return real(qb, b, a, kc, rung=rung)
 
-    monkeypatch.setattr(pallas_extract, "supports", fake_supports)
+    monkeypatch.setattr(pallas_fused, "resolve_topk_kernel", fake_resolve)
     with pytest.raises(AssertionError, match="full-array sweep"):
         eng._solve_extract_multipass(inp)
     # the guard saw both row counts: per-chunk then full

@@ -317,8 +317,8 @@ def test_call_with_timeout_result_error_and_deadline():
 # -- degradation ladder ------------------------------------------------------
 
 class _FakeEngine:
-    _degrade_rung = "tuned"
-    last_degrade_rung = "tuned"
+    _degrade_rung = "fused"
+    last_degrade_rung = "fused"
 
 
 def test_ladder_steps_down_per_oom():
@@ -327,16 +327,16 @@ def test_ladder_steps_down_per_oom():
 
     def solve(inp):
         seen.append(eng._degrade_rung)
-        if len(seen) < 3:
+        if len(seen) < 4:
             raise SimulatedResourceExhausted("RESOURCE_EXHAUSTED")
         return "answer"
 
     assert degrade.run_ladder(eng, None, solve) == "answer"
-    assert seen == ["tuned", "heuristic", "streaming"]
+    assert seen == ["fused", "tuned", "heuristic", "streaming"]
     assert eng.last_degrade_rung == "streaming"
-    assert eng._degrade_rung == "tuned"       # restored after the run
+    assert eng._degrade_rung == "fused"       # restored after the run
     assert stats.snapshot()["degradations"] == \
-        ["tuned->heuristic", "heuristic->streaming"]
+        ["fused->tuned", "tuned->heuristic", "heuristic->streaming"]
 
 
 def test_ladder_propagates_non_oom():
@@ -357,14 +357,15 @@ def test_ladder_heuristic_rung_suppresses_tune_cache():
 
     def solve(inp):
         seen.append(tune_cache.lookup_variant(32, 1024, a=8))
-        if len(seen) == 1:
+        if len(seen) <= 2:
             raise SimulatedResourceExhausted("RESOURCE_EXHAUSTED")
         return "ok"
 
     degrade.run_ladder(eng, None, solve)
-    # Rung 1 may consult the cache (None here: conftest pins a
-    # nonexistent path); rung 2 must not even try.
-    assert len(seen) == 2 and seen[1] is None
+    # The fused and tuned rungs may consult the cache (None here:
+    # conftest pins a nonexistent path); the heuristic rung must not
+    # even try.
+    assert len(seen) == 3 and seen[2] is None
 
 
 # -- engine-level byte-identical recovery ------------------------------------
@@ -391,9 +392,10 @@ def test_engine_recovers_transients_byte_identical():
     assert snap["retries"] >= 3 and snap["faults_injected"] == 3
 
 
-@pytest.mark.parametrize("times,rung", [(1, "heuristic"),
-                                        (2, "streaming"),
-                                        (3, "host")])
+@pytest.mark.parametrize("times,rung", [(1, "tuned"),
+                                        (2, "heuristic"),
+                                        (3, "streaming"),
+                                        (4, "host")])
 def test_engine_ladder_byte_identical(times, rung):
     inp = _small_input()
     golden = format_results(knn_golden(inp))

@@ -51,8 +51,8 @@ def test_cache_roundtrip_write_reload_hit(tune_cache_path):
 def test_cache_file_is_schema_validated(tune_cache_path):
     VariantCache().save(tune_cache_path)
     doc = json.load(open(tune_cache_path))
-    assert doc["schema"] == 1
-    assert doc["kernel"] == "extract_topk"
+    assert doc["schema"] == 2
+    assert doc["kernel"] == "pallas_topk"
     VariantCache.validate_doc(doc)  # round-trips its own schema
 
     doc["schema"] = 99
@@ -62,6 +62,49 @@ def test_cache_file_is_schema_validated(tune_cache_path):
         VariantCache.validate_doc({"schema": 1, "kernel": "extract_topk",
                                    "entries": {"k": {"variant":
                                                      {"tile_q": 7}}}})
+    # schema-2 entry keys must carry a known kernel namespace
+    with pytest.raises(ValueError):
+        VariantCache.validate_doc(
+            {"schema": 2, "kernel": "pallas_topk",
+             "entries": {"cpu|b16384|a8|kc16|float32":
+                         {"variant": {"tile_q": 64, "ne": 2,
+                                      "unroll": 1}}}})
+
+
+def test_schema1_cache_loads_leniently_into_extract_namespace(
+        tune_cache_path):
+    """A pre-fused (schema-1, extract-only) cache file still loads: its
+    keys upgrade to the extract_topk namespace in memory, so a tuned
+    machine keeps its winners across the schema bump — and the fused
+    namespace stays empty (never inherits extract winners)."""
+    v = {"tile_q": 64, "ne": 4, "unroll": 1}
+    with open(tune_cache_path, "w") as f:
+        json.dump({"schema": 1, "kernel": "extract_topk",
+                   "entries": {"cpu|b16384|a8|kc16|float32":
+                               {"variant": v}}}, f)
+    VariantCache.validate_doc(json.load(open(tune_cache_path)))
+    clear_lookup_memo()
+    assert lookup_variant(16, 12800, a=8, device_kind="cpu") == v
+    assert lookup_variant(16, 12800, a=8, device_kind="cpu",
+                          kernel="fused_topk") is None
+
+
+def test_fused_namespace_is_keyed_separately(tune_cache_path):
+    """Winners cached under kernel="fused_topk" resolve only through the
+    fused lookup; the extract namespace at the same (device, b, a, kc)
+    key is independent."""
+    vf = {"tile_q": 32, "tile_n": 256, "ne": 2, "unroll": 1}
+    ve = {"tile_q": 64, "ne": 4, "unroll": 1}
+    cache = VariantCache()
+    cache.put("cpu", 12800, 16, vf, a=8, kernel="fused_topk")
+    cache.put("cpu", 12800, 16, ve, a=8)
+    cache.save(tune_cache_path)
+    clear_lookup_memo()
+    assert lookup_variant(16, 12800, a=8, device_kind="cpu",
+                          kernel="fused_topk") == vf
+    assert lookup_variant(16, 12800, a=8, device_kind="cpu") == ve
+    with pytest.raises(ValueError):
+        cache.put("cpu", 12800, 16, ve, a=8, kernel="mystery_kernel")
 
 
 def test_put_rejects_invalid_variants():
@@ -222,8 +265,12 @@ def test_written_cache_drives_engine_resolution_and_parity(
                       1 << 30, staging="float32")
     pinned = {"tile_q": 32, "tile_n": 256, "ne": 2, "unroll": 1}
     cache = VariantCache()
-    # engine dispatch: chunk_rows 12800, qpad 128 (QUERY_TILE), a = na
+    # engine dispatch: chunk_rows 12800, qpad 128 (QUERY_TILE), a = na.
+    # The engine prefers the fused megakernel, which resolves through
+    # the fused_topk namespace — pin BOTH so whichever kernel dispatches
+    # sees the tuned tiles (and the span proves which one resolved).
     cache.put("cpu", 12800, kc, pinned, a=na)
+    cache.put("cpu", 12800, kc, pinned, a=na, kernel="fused_topk")
     cache.save(tune_cache_path)
     clear_lookup_memo()
 
@@ -243,6 +290,7 @@ def test_written_cache_drives_engine_resolution_and_parity(
     spans = [e for e in tracer.to_dict()["traceEvents"]
              if e.get("name") == "single.enqueue_extract"]
     assert spans and spans[0]["args"]["variant"] == pinned
+    assert spans[0]["args"]["impl"] == eng.last_extract_impl
     assert_same_results(got, knn_golden(inp), check_dists=False)
 
 

@@ -32,8 +32,22 @@ Writes one schema-1 RunRecord (obs.run). On a host with no TPU,
 why, and an interpret-mode parity + measured-iters demonstration at a
 small shape) instead of failing silently — never a missing artifact.
 
+``--fused`` (ISSUE 8) adds the fused distance→top-k megakernel arm
+(ops.pallas_fused: the MXU tile gate + fused tune-cache namespace):
+the fused kernel is timed INTERLEAVED with the ungated kernel in the
+same weather window (kernel-only, dispatch-corrected), and the
+RunRecord's counters block carries obs.kernel_cost.fused_topk_cost —
+including the analytic HBM write+read the fusion eliminates vs the
+materialize-then-reread two-pass pipeline
+(``hbm_bytes_saved_vs_two_pass`` / ``hbm_traffic_reduction_x``). On a
+no-TPU host, ``--fused --emit-unavailable`` writes the honest
+fused-roofline-unavailable record: an interpret-mode proof that the
+gate is a pure elision (fused vs ungated bit-identity, gate-zeroed
+iters on a hopeless warm block) plus the on-hardware recipe.
+
 Usage (DEFAULT env, real chip): python tools/roofline_extract.py
     [--out ROOFLINE_r06.json] [--n 204800 --q 10240 --a 64 --k 32]
+    [--fused --out ROOFLINE_FUSED_r08.json]
 """
 from __future__ import annotations
 
@@ -115,6 +129,91 @@ def emit_unavailable(args, dev) -> int:
     return 0 if parity else 1
 
 
+def emit_fused_unavailable(args, dev) -> int:
+    """The honest no-TPU artifact for the fused megakernel: an explicit
+    fused-roofline-unavailable RunRecord carrying (1) why, (2) an
+    interpret-mode proof that the MXU tile gate is a PURE ELISION —
+    fused vs ungated kernel bit-identical on fresh + warm folds, and a
+    provably-hopeless warm block costs the fused kernel ZERO loop
+    iterations even with the r6 block-skip prefilter off — and (3) the
+    analytic HBM-traffic elimination vs the two-pass pipeline at the
+    ROOFLINE_r05 dispatch shape, so the ~2x claim is a checked number
+    in the ledger while the ms win awaits hardware."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from dmlp_tpu.obs.kernel_cost import (fused_topk_cost,
+                                          two_pass_equivalent_cost)
+    from dmlp_tpu.obs.run import RunRecord
+    from dmlp_tpu.ops.pallas_extract import extract_topk
+    from dmlp_tpu.ops.pallas_fused import resolve_variant
+
+    n, nq, a, kc = 1024, 16, 8, 16
+    rng = np.random.default_rng(0)
+    d = jnp.asarray(rng.uniform(0, 100, (n, a)), jnp.float32)
+    q = jnp.asarray(rng.uniform(0, 100, (nq, a)), jnp.float32)
+    d_far = d + 1000.0   # warm fold no candidate of which can insert
+    runs = {}
+    for gate in (True, False):
+        od1, oi1, it1 = extract_topk(q, d, n_real=n, kc=kc,
+                                     interpret=True, block_skip=False,
+                                     mxu_gate=gate)
+        od2, oi2, it2 = extract_topk(q, d_far, od1, oi1, n_real=n,
+                                     id_base=n, kc=kc, interpret=True,
+                                     block_skip=False, mxu_gate=gate)
+        runs[gate] = (np.asarray(od2), np.asarray(oi2),
+                      int(np.asarray(it1).sum()),
+                      int(np.asarray(it2).sum()))
+    parity = (np.array_equal(runs[True][0], runs[False][0])
+              and np.array_equal(runs[True][1], runs[False][1]))
+    gate_elides = runs[True][3] == 0 and runs[False][3] > 0
+    iters_total = runs[True][2] + runs[True][3]
+
+    # The acceptance number at the ROOFLINE_r05 dispatch shape: what the
+    # fusion eliminates vs the materialize-then-reread two-pass pipeline.
+    qb, b = args.q, args.n
+    fused = fused_topk_cost(qb, b, args.a, kc)
+    two = two_pass_equivalent_cost(qb, b, args.a, kc)
+
+    why = (
+        f"no TPU reachable from this container (backend={dev.platform}); "
+        "the fused-vs-two-pass kernel-only ms needs the real chip. "
+        "On hardware: `python -m dmlp_tpu.tune --kernel both` (sweep the "
+        "fused namespace), then `python tools/roofline_extract.py --fused "
+        "--reps 3` (interleaved fused/ungated same-weather arms), then "
+        "`python -m dmlp_tpu.report` + `make perf-gate` to fold the _r08 "
+        "round into the trajectory. Expected: the MXU gate converts the "
+        "33.6 ms extraction term's warm no-improve blocks (ROOFLINE_r05: "
+        "13773 iters at 22.9% of roof) from one VPU prefilter pass each "
+        "into NOTHING — the matmul tile itself is skipped.")
+    rec = RunRecord(
+        kind="roofline", tool="tools/roofline_extract_fused",
+        config={"device": dev.platform, "shape": [args.n, args.q, args.a],
+                "k": args.k, "requested_reps": args.reps, "fused": True},
+        metrics={
+            "roofline_unavailable": why,
+            "fused_vs_two_pass_ms_unavailable":
+                "kernel-only ms requires TPU",
+            "hbm_bytes_saved_vs_two_pass":
+                fused["hbm_bytes_saved_vs_two_pass"],
+            "hbm_traffic_reduction_x": fused["hbm_traffic_reduction_x"],
+            "hbm_bytes_fused": fused["bytes_accessed"],
+            "hbm_bytes_two_pass_equiv": two["bytes_accessed"],
+            "cpu_interpret_check": {
+                "shape": [n, nq, a], "kc": kc,
+                "variant": resolve_variant(kc, n, nq, a),
+                "fused_vs_ungated_parity": bool(parity),
+                "gate_zeroes_hopeless_block_iters": bool(gate_elides),
+                "iters_warm_block_gated": runs[True][3],
+                "iters_warm_block_ungated": runs[False][3],
+            },
+        },
+        counters=fused_topk_cost(nq, n, a, kc, iters_total=iters_total))
+    rec.write(args.out)
+    print(rec.to_json())
+    return 0 if parity and gate_elides else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="ROOFLINE_r06.json")
@@ -127,7 +226,15 @@ def main() -> int:
                     help="on a non-TPU host, write the explicit "
                          "roofline-unavailable RunRecord (exit 0) "
                          "instead of failing")
+    ap.add_argument("--fused", action="store_true",
+                    help="add the fused-megakernel arm (ops.pallas_fused"
+                         "): interleaved fused vs ungated kernel-only "
+                         "timing + the analytic HBM-traffic elimination "
+                         "(ISSUE 8); with --emit-unavailable, writes "
+                         "the fused parity-proof marker record")
     args = ap.parse_args()
+    if args.fused and args.out == "ROOFLINE_r06.json":
+        args.out = "ROOFLINE_FUSED_r08.json"
 
     import jax
     import jax.numpy as jnp
@@ -135,7 +242,8 @@ def main() -> int:
     dev = jax.devices()[0]
     if dev.platform != "tpu":
         if args.emit_unavailable:
-            return emit_unavailable(args, dev)
+            return emit_fused_unavailable(args, dev) if args.fused \
+                else emit_unavailable(args, dev)
         print(f"FATAL: roofline needs the real chip, got {dev.platform} "
               "(--emit-unavailable writes the explicit marker record)")
         return 1
@@ -174,6 +282,12 @@ def main() -> int:
         od, _, _ = extract_topk(q_, d_, n_real=n, kc=kc, block_skip=False)
         return od
 
+    # --- the fused megakernel (MXU tile gate + fused tune namespace) ----
+    def kernel_fused_fn(q_, d_):
+        from dmlp_tpu.ops.pallas_fused import fused_topk
+        od, _, _ = fused_topk(q_, d_, n_real=n, kc=kc)
+        return od
+
     # --- MXU floor: bare fused distance matmul, same precision/fence ----
     @jax.jit
     def dist_only(q_, d_):
@@ -195,6 +309,8 @@ def main() -> int:
     # meaningful (verify-skill methodology).
     fns = {"dispatch": trivial, "solve": solve_fn, "kernel": kernel_fn,
            "kernel_noskip": kernel_noskip_fn, "mxu": dist_only}
+    if args.fused:
+        fns["kernel_fused"] = kernel_fused_fn
     rounds = {k: [] for k in fns}
     for r in range(5):
         for name in (list(fns) if r % 2 == 0 else list(fns)[::-1]):
@@ -261,6 +377,24 @@ def main() -> int:
         "extract_iters_total": total_iters,
         "extract_iters_total_noskip": total_iters_noskip,
     }
+    if args.fused:
+        from dmlp_tpu.obs.kernel_cost import fused_topk_cost
+        from dmlp_tpu.ops.pallas_fused import fused_topk
+        fused_c = med["kernel_fused"] - dispatch_ms
+        _, _, it_f = fused_topk(qd, dd, n_real=n, kc=kc)
+        fc = fused_topk_cost(qpad, npad, a, kc,
+                             iters_total=int(np.asarray(it_f).sum()))
+        rec["fused"] = {
+            "kernel_ms_fused": round(fused_c, 2),
+            "fused_vs_ungated_speedup": round(
+                kernel_c / max(fused_c, 1e-6), 3),
+            "pct_of_roof_fused": round(100.0 * floor / max(fused_c, 1e-6),
+                                       1),
+            "extract_iters_total_fused": int(np.asarray(it_f).sum()),
+            "hbm_bytes_saved_vs_two_pass":
+                fc["hbm_bytes_saved_vs_two_pass"],
+            "hbm_traffic_reduction_x": fc["hbm_traffic_reduction_x"],
+        }
     rec["verdict"] = (
         f"binding floor = {'MXU' if mxu_c > hbm_floor_ms else 'HBM'} "
         f"({floor:.1f} ms, dispatch-corrected) at HIGHEST-precision f32 "
