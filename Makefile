@@ -6,7 +6,7 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
 .PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
-	perf-gate check lint chaos-smoke clean
+	perf-gate check lint chaos-smoke telemetry-smoke clean
 
 all: native
 
@@ -15,7 +15,8 @@ native: native/_fastparse.so
 native/_fastparse.so: native/fastparse.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
-test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint chaos-smoke
+test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint \
+	chaos-smoke telemetry-smoke
 	python -m pytest tests/ -q
 
 # Static analysis + runtime-sanitizer smoke (README "Static analysis &
@@ -144,6 +145,23 @@ chaos-smoke:
 	rm -f outputs/chaos/CHAOS_SMOKE.jsonl
 	JAX_PLATFORMS=cpu python tools/chaos_run.py --smoke \
 	  --out outputs/chaos --record outputs/chaos/CHAOS_SMOKE.jsonl
+
+# Live-telemetry smoke (README "Live telemetry, memory watermarks &
+# flight recorder"): bench config 1 through the real CLI in interleaved
+# --telemetry on/off pairs — contract stdout byte-identical, the
+# OpenMetrics snapshot structurally valid (with the honest
+# mem.stats_unavailable gauge on this CPU backend), the analytic
+# peak-HBM model reconciled against the measured watermark within the
+# documented basis bounds (or the explicit marker), a FLIGHT_*.json
+# post-mortem left by a retries-exhausted fault, and the overhead +
+# watermark numbers round-tripped through the perf ledger as a
+# telemetry/ series with raw per-arm samples.
+telemetry-smoke:
+	mkdir -p outputs/telemetry
+	rm -f outputs/telemetry/TELEMETRY_SMOKE.jsonl
+	JAX_PLATFORMS=cpu python tools/telemetry_smoke.py \
+	  --out outputs/telemetry \
+	  --record outputs/telemetry/TELEMETRY_SMOKE.jsonl
 
 clean:
 	rm -f native/_fastparse.so

@@ -283,7 +283,8 @@ def run_config(config_id: int, base_dir: str = ".",
                record_path: Optional[str] = None,
                profile_dir: Optional[str] = None,
                obs_overhead: bool = False,
-               fused_ab: bool = False) -> dict:
+               fused_ab: bool = False,
+               telemetry_dir: Optional[str] = None) -> dict:
     """Full benchmark flow for one config; returns a result summary dict.
 
     ``reps`` > 1 runs the engine subprocess that many times and reports
@@ -355,6 +356,15 @@ def run_config(config_id: int, base_dir: str = ".",
     obs_flags: list = []
     if counters:
         obs_flags.append("--counters")
+    if telemetry_dir:
+        # Per-config live-telemetry capture (obs.telemetry): the engine
+        # subprocess rewrites an OpenMetrics snapshot while it runs;
+        # the final file is linked from the config's RunRecord
+        # artifacts like the trace/metrics pair.
+        os.makedirs(telemetry_dir, exist_ok=True)
+        obs_flags += ["--telemetry",
+                      os.path.join(telemetry_dir,
+                                   f"telemetry_config{config_id}.prom")]
     if trace_dir:
         os.makedirs(trace_dir, exist_ok=True)
         obs_flags += ["--trace",
@@ -506,7 +516,8 @@ def run_config(config_id: int, base_dir: str = ".",
             oracle_want=want if check_reps else None))
     if record_path:
         _append_run_record(record_path, cfg, res, trace_dir,
-                           profile=profile, cpu_pinned=cpu_pinned)
+                           profile=profile, cpu_pinned=cpu_pinned,
+                           telemetry_dir=telemetry_dir)
     return res
 
 
@@ -699,7 +710,8 @@ def _measure_fused_ab(cfg: BenchConfig, input_path: str,
 def _append_run_record(record_path: str, cfg: BenchConfig, res: dict,
                        trace_dir: Optional[str],
                        profile: Optional[tuple] = None,
-                       cpu_pinned: bool = False) -> None:
+                       cpu_pinned: bool = False,
+                       telemetry_dir: Optional[str] = None) -> None:
     """One versioned RunRecord per config run (obs.run) — the uniform
     artifact new bench emitters share instead of private BENCH_* shapes.
     ``profile`` is ("path", dir) to link an on-device capture from the
@@ -724,6 +736,11 @@ def _append_run_record(record_path: str, cfg: BenchConfig, res: dict,
         }
         artifacts = {k: p for k, p in candidates.items()
                      if os.path.exists(p)}
+    if telemetry_dir and cfg.procs == 1 and not failed:
+        tpath = os.path.join(telemetry_dir,
+                             f"telemetry_config{cfg.config_id}.prom")
+        if os.path.exists(tpath):
+            artifacts["telemetry"] = tpath
     if profile is not None:
         if profile[0] == "path" and not failed:
             artifacts["profile"] = profile[1]
@@ -798,6 +815,12 @@ def main(argv=None) -> int:
     p.add_argument("--counters", action="store_true",
                    help="engine subprocesses print XLA cost-analysis + "
                         "roofline summaries on stderr")
+    p.add_argument("--telemetry", metavar="DIR", default=None,
+                   dest="telemetry_dir",
+                   help="per-config live-telemetry capture: the engine "
+                        "subprocess rewrites DIR/telemetry_configN.prom "
+                        "as an OpenMetrics snapshot (obs.telemetry), "
+                        "linked from the config's RunRecord artifacts")
     p.add_argument("--profile", metavar="DIR", default=None,
                    dest="profile_dir",
                    help="per-config on-device jax.profiler capture into "
@@ -828,7 +851,8 @@ def main(argv=None) -> int:
                          record_path=args.metrics,
                          profile_dir=args.profile_dir,
                          obs_overhead=args.obs_overhead,
-                         fused_ab=args.fused_ab)
+                         fused_ab=args.fused_ab,
+                         telemetry_dir=args.telemetry_dir)
         # `timed_out` is a marker, not a verdict (markers never gate):
         # the config's RunRecord documents the hang; a wrong checksum
         # still fails the run.

@@ -16,9 +16,9 @@ from typing import Iterable, List, Optional, Sequence
 from dmlp_tpu.check.common import ModuleInfo
 from dmlp_tpu.check.findings import Finding
 
-ALL_FAMILIES = ("R0", "R1", "R2", "R3", "R4", "R5")
+ALL_FAMILIES = ("R0", "R1", "R2", "R3", "R4", "R5", "R6")
 #: families make check enforces by default; R0 rides in `make lint`
-DEFAULT_FAMILIES = ("R1", "R2", "R3", "R4", "R5")
+DEFAULT_FAMILIES = ("R1", "R2", "R3", "R4", "R5", "R6")
 
 
 def package_root() -> str:
@@ -80,6 +80,7 @@ def analyze_modules(modules: List[ModuleInfo],
     from dmlp_tpu.check.dispatchcost import DispatchCostRule
     from dmlp_tpu.check.hostsync import HostSyncRule
     from dmlp_tpu.check.hygiene import HygieneRule
+    from dmlp_tpu.check.metricnames import MetricNameRule
     from dmlp_tpu.check.recompile import RecompileRule
     from dmlp_tpu.check.resilient import ResilientRule
 
@@ -100,6 +101,8 @@ def analyze_modules(modules: List[ModuleInfo],
         rules.append(CompatRule())
     if "R5" in fams:
         rules.append(ResilientRule())
+    if "R6" in fams:
+        rules.append(MetricNameRule(modules))
     for mod in modules:
         for rule in rules:
             rule.run(mod, add)

@@ -54,6 +54,11 @@ RULES = {
     # R5 — resilience-path silent swallowing
     "R501": "broad `except Exception` in a resilience-wrapped path"
             " without re-raise or `# check: no-retry` annotation",
+    # R6 — telemetry metric-name contract (obs.telemetry registry)
+    "R601": "registry metric name is not a literal snake_case dotted"
+            " string (dynamic names fork unbounded series)",
+    "R602": "metric name registered with conflicting kinds"
+            " (counter vs gauge vs histogram)",
 }
 
 #: rule id -> allowlist directive that silences it at a call site.
@@ -64,6 +69,7 @@ ALLOW_DIRECTIVES = {
     "R3": "allow-host-sync",
     "R4": "allow-compat",
     "R5": "no-retry",
+    "R6": "allow-metric-name",
 }
 
 

@@ -80,7 +80,8 @@ def make_engine(config: EngineConfig, stderr=None):
 
 def _emit_metrics(path: str, args, inp, timer: EngineTimer, phase_ms: dict,
                   counters: Optional[dict], comms: Optional[dict],
-                  extract_impl: Optional[str] = None) -> None:
+                  extract_impl: Optional[str] = None,
+                  mem_model: Optional[dict] = None) -> None:
     """Append per-phase records + one run summary to the metrics JSONL.
 
     The summary is the contract record: it always carries a ``counters``
@@ -110,6 +111,12 @@ def _emit_metrics(path: str, args, inp, timer: EngineTimer, phase_ms: dict,
             # | "extract") — the bench harness's fused A/B reads this to
             # refuse recording a vacuous (never-dispatched-fused) pair.
             summary["extract_impl"] = extract_impl
+        if mem_model is not None:
+            # The analytic peak-HBM model + measured watermark
+            # reconcile (obs.memwatch) — the mem block carries the
+            # explicit mem_stats_unavailable marker where the backend
+            # reports no memory, never silence.
+            summary["mem"] = mem_model
         # Recovery is never silent: when the resilience layer did
         # anything (or a fault schedule was installed, even if nothing
         # fired), the summary carries the counters the chaos harness
@@ -214,13 +221,35 @@ def main(argv: Optional[Sequence[str]] = None,
                              "chaos harness's knob; $DMLP_TPU_FAULTS "
                              "sets it too. Recovery must keep stdout "
                              "byte-identical (make chaos-smoke)")
+    parser.add_argument("--telemetry", metavar="FILE", default=None,
+                        help="live telemetry (obs.telemetry): "
+                             "periodically rewrite FILE as an "
+                             "OpenMetrics snapshot (metrics registry + "
+                             "device-memory watermarks + span "
+                             "latencies), and arm the crash flight "
+                             "recorder (FLIGHT_*.json next to FILE on "
+                             "crash/fatal fault/SIGTERM). Contract "
+                             "channels stay byte-identical")
+    parser.add_argument("--telemetry-port", type=int, default=None,
+                        metavar="PORT",
+                        help="opt-in localhost HTTP endpoint serving "
+                             "the OpenMetrics text on GET /metrics "
+                             "(0 = ephemeral port; implies the "
+                             "telemetry session)")
     args = parser.parse_args(argv)
 
     stdin = stdin or sys.stdin
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
 
-    tracer = probe = None
+    tracer = probe = telemetry_session = None
+    from dmlp_tpu.resilience import inject as rs_inject
+    from dmlp_tpu.resilience import stats as rs_stats
+    rs_stats.reset()   # resets the registry's resilience.* counters too
+    if args.telemetry or args.telemetry_port is not None:
+        from dmlp_tpu.obs import telemetry
+        telemetry_session = telemetry.start(path=args.telemetry,
+                                            port=args.telemetry_port)
     if args.trace:
         from dmlp_tpu.obs import trace as obs_trace
         tracer = obs_trace.install(
@@ -228,12 +257,20 @@ def main(argv: Optional[Sequence[str]] = None,
     if args.metrics or args.counters:
         from dmlp_tpu.obs import counters as obs_counters
         probe = obs_counters.install()
-    from dmlp_tpu.resilience import inject as rs_inject
-    from dmlp_tpu.resilience import stats as rs_stats
-    rs_stats.reset()
     schedule = rs_inject.install_from_env(args.faults)
     try:
         return _run_cli(parser, args, stdin, stdout, stderr, tracer, probe)
+    except Exception:
+        # The whole reason the flight recorder exists: the last N
+        # spans/events/metric deltas survive the crash as a
+        # FLIGHT_*.json post-mortem artifact. Exception, NOT
+        # BaseException: a usage error's SystemExit (parser.error) is
+        # not a crash and must not leave a misleading FLIGHT artifact;
+        # external kills are the SIGTERM handler's job.
+        if telemetry_session is not None:
+            from dmlp_tpu.obs import telemetry
+            telemetry.dump_on_crash("crash")
+        raise
     finally:
         if schedule is not None:
             rs_inject.write_log_if_requested()
@@ -244,6 +281,8 @@ def main(argv: Optional[Sequence[str]] = None,
         if probe is not None:
             from dmlp_tpu.obs import counters as obs_counters
             obs_counters.uninstall()
+        if telemetry_session is not None:
+            telemetry_session.close()
 
 
 def _run_cli(parser, args, stdin, stdout, stderr, tracer, probe) -> int:
@@ -315,12 +354,31 @@ def _run_cli(parser, args, stdin, stdout, stderr, tracer, probe) -> int:
         if engine is not None and getattr(engine, "last_comms", None):
             from dmlp_tpu.obs.comms import summarize
             comms = summarize(engine.last_comms)
+        mem_model = None
+        if args.metrics and engine is not None:
+            # Only _emit_metrics consumes the reconcile; a
+            # --counters/--trace-only run must not pay the
+            # live-array enumeration for a discarded result.
+            # Analytic peak-HBM model + watermark reconcile
+            # (obs.memwatch): against the telemetry sampler's tracked
+            # peak when a session ran, else a one-shot basis — with
+            # the explicit marker where the backend reports nothing.
+            from dmlp_tpu.obs import memwatch, telemetry
+            try:
+                model = memwatch.model_for_engine(engine, inp)
+                sess = telemetry.session()
+                measured = (sess.sampler.measured_peak() if sess
+                            else memwatch.measured_watermark())
+                mem_model = memwatch.reconcile(model, measured)
+            except Exception:  # check: no-retry — obs never fails a run
+                mem_model = None
         if args.metrics:
             _emit_metrics(args.metrics, args, inp, timer, phase_ms,
                           counters, comms,
                           extract_impl=getattr(engine, "last_extract_impl",
                                                None)
-                          if engine is not None else None)
+                          if engine is not None else None,
+                          mem_model=mem_model)
         if args.counters:
             _emit_counters_stderr(counters, timer.elapsed_ms, stderr)
         if tracer is not None:

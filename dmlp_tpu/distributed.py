@@ -60,6 +60,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="deterministic fault-injection schedule (JSON; "
                         "dmlp_tpu.resilience.inject); $DMLP_TPU_FAULTS "
                         "sets it too")
+    p.add_argument("--telemetry", metavar="FILE", default=None,
+                   help="per-rank live telemetry (obs.telemetry): "
+                        "OpenMetrics snapshot rewrite of FILE "
+                        "(.rankNN-suffixed when processes > 1, like "
+                        "$DMLP_TPU_FAULT_LOG) + crash flight recorder")
     p.add_argument("--supervise", type=int, default=None, metavar="N",
                    help="launcher mode: spawn N rank processes of this "
                         "entry under heartbeat + timeout supervision "
@@ -92,6 +97,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     initialize(coordinator_address=args.coordinator,
                num_processes=args.processes, process_id=args.process_id,
                auto=args.auto)
+
+    telemetry_session = None
+    if args.telemetry:
+        # One telemetry file per process (ranks share the argv), same
+        # suffix convention as the fault log below. The sampler's
+        # heartbeat.age_s gauge reads the supervisor's
+        # $DMLP_TPU_HEARTBEAT file when one is set. Started strictly
+        # AFTER initialize(): the sampler polls jax.devices() once jax
+        # is imported, and a tick landing before distributed init
+        # would initialize the local single-process backend first.
+        tpath = args.telemetry
+        if (args.processes or 1) > 1:
+            tpath += f".rank{args.process_id or 0:02d}"
+        from dmlp_tpu.obs import telemetry
+        telemetry_session = telemetry.start(path=tpath)
 
     tracer = None
     if args.trace:
@@ -131,6 +151,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         distributed_contract_run(args.input, engine, out=buf,
                                  warmup=args.warmup)
+    except Exception:
+        if telemetry_session is not None:
+            # The dying rank's own post-mortem: the parent supervisor
+            # only sees launch_failed; the ring buffer lives here.
+            from dmlp_tpu.obs import telemetry
+            telemetry.dump_on_crash("crash")
+        raise
     finally:
         sys.stdout.flush()
         os.dup2(real_stdout, 1)
@@ -154,6 +181,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             if log_path:
                 schedule.write_log(log_path)
             rs_inject.uninstall()
+        if telemetry_session is not None:
+            telemetry_session.close()
     sys.stdout.write(buf.getvalue())
     sys.stdout.flush()
     return 0
@@ -187,6 +216,8 @@ def _run_supervisor(args) -> int:
         base += ["--trace", args.trace]
     if args.faults:
         base += ["--faults", args.faults]
+    if args.telemetry:
+        base += ["--telemetry", args.telemetry]
 
     def make_cluster(attempt: int):
         # NOTE: same probe-then-rebind TOCTOU window as the bench

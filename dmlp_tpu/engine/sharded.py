@@ -38,6 +38,7 @@ from dmlp_tpu.engine.single import (ChunkThrottle, MeasuredIters,
 from dmlp_tpu.io.grammar import KNNInput
 from dmlp_tpu.io.report import QueryResult
 from dmlp_tpu.obs import counters as obs_counters
+from dmlp_tpu.obs import memwatch, telemetry
 from dmlp_tpu.obs.comms import engine_comms
 from dmlp_tpu.obs.trace import span as obs_span
 from dmlp_tpu.ops.topk import TopK, streaming_topk
@@ -106,6 +107,9 @@ class ShardedEngine:
         # programs return per-shard kernel iters through their fold
         # outputs; engine.single.flush_measured_iters drains post-fence)
         self._pending_iters: list = []
+        # Analytic per-device peak-HBM model of the last solve
+        # (obs.memwatch); populated only under a telemetry session.
+        self.last_mem_model = None
 
     def _np_dtype(self):
         """Wire dtype from the engine's (possibly no_auto_coarsen-swapped)
@@ -556,6 +560,9 @@ class ShardedEngine:
                     od, ol, oi = ostep(od, ol, oi, a_dev, qo_dev, lab_dev,
                                        sc)
                 throttle.tick(od if ostep is not None else cd)
+                # Watermark tick while the staged chunk is still
+                # referenced (no-op without a telemetry session).
+                telemetry.sample_memory_now()
         mi.done()
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
@@ -590,6 +597,7 @@ class ShardedEngine:
         self.last_comms = []     # no stale traffic either
         self._pending_iters = []
         self.last_extract_impl = None
+        memwatch.note_engine_model(self, inp)
         out = self._solve_chunked_extract(inp, routed=False)
         if out is not None:
             top, _ = out
@@ -812,7 +820,11 @@ class ShardedEngine:
         from dmlp_tpu.io.grammar import subset_queries
 
         n = inp.params.num_data
+        memwatch.note_engine_model(self, inp)
         segments = self._solve_segments(inp)
+        # Watermark tick at peak residency (solve enqueued, nothing
+        # fetched); no-op without a telemetry session.
+        telemetry.sample_memory_now()
         self.last_repairs = 0  # tie-overflow repair rate, for bench records
         merged: List[QueryResult] = [None] * inp.params.num_queries
         dn_max = None
@@ -934,6 +946,7 @@ class ShardedEngine:
         self.last_comms = []
         self._pending_iters = []
         self.last_extract_impl = None
+        memwatch.note_engine_model(self, inp)
         out = self._solve_chunked_extract(inp)
         if out is not None:
             from dmlp_tpu.engine.single import _device_epilogue

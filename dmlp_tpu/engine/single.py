@@ -34,6 +34,7 @@ from dmlp_tpu.engine.finalize import (boundary_hazard, finalize_host,
 from dmlp_tpu.io.grammar import KNNInput, subset_queries
 from dmlp_tpu.io.report import QueryResult
 from dmlp_tpu.obs import counters as obs_counters
+from dmlp_tpu.obs import memwatch, telemetry
 from dmlp_tpu.obs.trace import span as obs_span
 from dmlp_tpu.ops.topk import TopK, init_topk, make_block_step, streaming_topk
 from dmlp_tpu.ops.vote import majority_vote, report_order
@@ -496,6 +497,9 @@ class SingleChipEngine:
         # extract paths queue when a cost probe is installed; flushed to
         # obs.counters after the solve fence (measured extraction term).
         self._pending_iters: list = []
+        # Analytic peak-HBM model of the last solve (obs.memwatch);
+        # populated only while a telemetry session is active.
+        self.last_mem_model = None
 
     def _prep(self, inp: KNNInput):
         cfg = self.config
@@ -617,6 +621,11 @@ class SingleChipEngine:
                     carries[b] = _chunk_fold(carries[b], q_dev[b], da, dl,
                                              di, **statics)
                 throttle.tick(carries[-1].dists)
+                # Watermark tick while the chunk is still referenced —
+                # chunk arrays are loop-locals, so a post-loop sample
+                # would miss the staging window (no-op unless a
+                # telemetry session is active).
+                telemetry.sample_memory_now()
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         if nqb == 1:
@@ -703,6 +712,7 @@ class SingleChipEngine:
                     interpret=interpret)
                 mi.add(_iters)
                 throttle.tick(od)
+                telemetry.sample_memory_now()   # staging window live
         mi.done()
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
@@ -870,6 +880,7 @@ class SingleChipEngine:
         # bytes), well under the resident budget.
         d_full = chunks[0][0] if len(chunks) == 1 \
             else jnp.concatenate([c[0] for c in chunks], axis=0)
+        telemetry.sample_memory_now()  # resident dataset ×2 peak (concat)
         del chunks  # free the duplicate once the concat is enqueued —
         # otherwise the dataset is HBM-resident TWICE for the whole sweep
         if npasses > 1:
@@ -1026,6 +1037,7 @@ class SingleChipEngine:
                 chunk_rows=chunk_rows, k=ko,
                 select=select_out, use_pallas=cfg.use_pallas)
             throttle.tick(carry_o.dists)
+            telemetry.sample_memory_now()   # staging window live
         mi.done()
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
@@ -1071,8 +1083,10 @@ class SingleChipEngine:
     def candidates(self, inp: KNNInput) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Device pass: (Q, K) selection-ordered candidate lists as NumPy."""
         kmax = int(inp.ks.max()) if inp.params.num_queries else 0
+        memwatch.note_engine_model(self, inp)
         with staging_for_k(self, kmax):
             out, qpad = self._solve(inp)
+        telemetry.sample_memory_now()
         nq = inp.params.num_queries
         # Explicit fenced readback (the result fetch IS the fence); the
         # sanitizer's transfer guard allows device_get, never implicit
@@ -1108,7 +1122,12 @@ class SingleChipEngine:
         import time as _time
 
         n = inp.params.num_data
+        memwatch.note_engine_model(self, inp)
         segments = self._solve_segments(inp)
+        # Watermark tick at peak residency: the solve is enqueued, the
+        # staged chunks/carries are live, nothing is fetched yet (no-op
+        # without a telemetry session).
+        telemetry.sample_memory_now()
         self.last_repairs = 0  # tie-overflow repair rate, for bench records
         self.last_comms = []   # one chip: no collectives (obs.comms)
         merged: List[QueryResult] = [None] * inp.params.num_queries
@@ -1197,8 +1216,10 @@ class SingleChipEngine:
         num_labels = int(inp.labels.max()) + 1 if inp.params.num_data else 1
         merged: List[QueryResult] = [None] * inp.params.num_queries
         self.last_comms = []   # one chip: no collectives (obs.comms)
+        memwatch.note_engine_model(self, inp)
         with no_auto_coarsen(self):
             segments = self._solve_segments(inp, allow_multipass=False)
+        telemetry.sample_memory_now()
         for top, qpad, idx, _select in segments:
             sub = inp if idx is None else subset_queries(inp, idx)
             nq = sub.params.num_queries

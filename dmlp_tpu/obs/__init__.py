@@ -28,6 +28,20 @@ timing/metrics schemas:
 - :mod:`dmlp_tpu.obs.run` — the versioned :class:`RunRecord` artifact
   writer all emitters share (replacing the divergent ``BENCH_*.json``
   shapes going forward; the legacy ``tools/*`` emitters are migrated).
+- :mod:`dmlp_tpu.obs.telemetry` — the LIVE half: a process-wide
+  thread-safe metrics registry (counters / gauges / log-bucket
+  streaming histograms with bounded-error p50/p95/p99), a background
+  device-memory sampler, OpenMetrics file/HTTP export
+  (``--telemetry``), and the crash flight recorder (bounded
+  span/event ring dumped as ``FLIGHT_*.json`` on crash, fatal fault,
+  or SIGTERM). The resilience counters write through this registry —
+  one source of truth for live scrapes and end-of-run blocks.
+- :mod:`dmlp_tpu.obs.memwatch` — device-memory watermarks: the
+  analytic peak-HBM resident-set model per engine/config (the comms
+  model's missing memory sibling), measured bases
+  (``memory_stats()`` / live-array bytes, with the explicit
+  ``mem_stats_unavailable`` marker), and their reconciliation under
+  documented per-basis tolerance bounds.
 - :mod:`dmlp_tpu.obs.ledger` — the perf ledger: ingests every run
   artifact (schema RunRecords AND the grandfathered legacy shapes)
   into per-series round-keyed trajectories with noise-aware A/B deltas

@@ -82,7 +82,7 @@ ARTIFACT_PATTERNS = (
 #: series units whose LOWER values are better (everything timing);
 #: key-name suffix heuristics — see _better_direction
 _LOWER_BETTER_HINTS = ("_ms", "_s", "_us", "_sec", "ms", "elapsed",
-                      "time", "wall")
+                      "time", "wall", "overhead_pct", "peak_hbm")
 # NOTE: no bare "pairs" hint — it would substring-match "repairs"
 # (a repair COUNT, where more is worse) and invert the gate's verdict;
 # qd_pairs_per_sec is already covered by "per_sec".
@@ -256,6 +256,12 @@ def _runrecord_series_name(rec: RunRecord, key: str) -> str:
 
     - dmlp_tpu.bench per-config records continue the ``HARNESS_rNN``
       series (``harness/configN/<metric>``);
+    - telemetry snapshot/smoke records (kind "telemetry": the live
+      registry serialized as a RunRecord, and tools/telemetry_smoke.py's
+      overhead + peak-HBM reconcile) key ``telemetry/<metric>`` — ONE
+      family for both emitters so the peak-HBM watermark,
+      model-vs-measured delta, and telemetry-overhead series stay
+      round-comparable regardless of which tool wrote the round;
     - tools.trainbench_moe continues ``trainbench/moe/<arm>/<metric>``
       (``a2a_median_ms`` -> ``trainbench/moe/a2a/median_ms``);
     - tools.bench_offload_ladder continues
@@ -266,6 +272,9 @@ def _runrecord_series_name(rec: RunRecord, key: str) -> str:
         else None
     if rec.tool == "dmlp_tpu.bench" and cid is not None:
         return f"harness/config{cid}/{key}"
+    if rec.kind == "telemetry":
+        cfg_tag = f"/config{cid}" if cid is not None else ""
+        return f"telemetry{cfg_tag}/{key}"
     if rec.tool == "tools.trainbench_moe":
         m = re.match(r"(dense|a2a)_(.+)$", key)
         if m:

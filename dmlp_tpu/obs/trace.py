@@ -36,6 +36,61 @@ from typing import Any, Dict, List, Optional
 
 _clock = time.perf_counter
 
+# -- telemetry bridge ---------------------------------------------------------
+# When a telemetry session (obs.telemetry) is active it registers
+# observers here; every completed span / instant is forwarded (span
+# latency histograms + flight-recorder events) WHETHER OR NOT a Tracer
+# is installed — `span()` hands out a minimal timing span when only the
+# observer wants it. Both slots None (the default) keeps the
+# uninstrumented fast path at one module-global read.
+_span_observer = None
+_instant_observer = None
+
+
+def set_telemetry_observer(span_cb, instant_cb) -> None:
+    """Install/clear the telemetry forwarding callbacks.
+    ``span_cb(name, dur_ms, args)``; ``instant_cb(name, args)``."""
+    global _span_observer, _instant_observer
+    _span_observer = span_cb
+    _instant_observer = instant_cb
+
+
+class _TelemetrySpan:
+    """Minimal timing span used when telemetry observes but no Tracer
+    is installed: measures wall duration (honoring device fences, like
+    the real Span) and forwards one observation — no event storage."""
+
+    __slots__ = ("name", "args", "_t0", "_fences")
+
+    def __init__(self, name: str, args: Dict[str, Any]):
+        self.name = name
+        self.args = dict(args) if args else {}
+        self._t0 = 0.0
+        self._fences: list = []
+
+    def set(self, **kwargs) -> None:
+        self.args.update(kwargs)
+
+    def fence(self, value) -> None:
+        self._fences.append(value)
+
+    def __enter__(self) -> "_TelemetrySpan":
+        self._t0 = _clock()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._fences:
+            try:
+                import jax
+                jax.block_until_ready(self._fences)
+            except Exception:
+                pass
+            self._fences = []
+        cb = _span_observer
+        if cb is not None:
+            cb(self.name, (_clock() - self._t0) * 1e3, self.args)
+        return False
+
 
 class _NullSpan:
     """Shared no-op span: the uninstrumented fast path. Stateless, so one
@@ -177,6 +232,9 @@ class Tracer:
         if args:
             ev["args"] = args
         self._append(ev)
+        cb = _span_observer
+        if cb is not None:
+            cb(name, max((t1 - t0) * 1e3, 0.0), args)
 
     def _tid(self) -> int:
         ident = threading.get_ident()
@@ -231,16 +289,24 @@ def active() -> Optional[Tracer]:
 
 
 def span(name: str, **args):
-    """Instrumentation hook: a Span on the installed tracer, or the shared
-    no-op span when tracing is off (the common case; near-zero cost)."""
+    """Instrumentation hook: a Span on the installed tracer, a minimal
+    timing span when only a telemetry session observes, or the shared
+    no-op span when both are off (the common case; near-zero cost)."""
     t = _active
-    return t.span(name, **args) if t is not None else NULL_SPAN
+    if t is not None:
+        return t.span(name, **args)
+    if _span_observer is not None:
+        return _TelemetrySpan(name, args)
+    return NULL_SPAN
 
 
 def instant(name: str, **args) -> None:
     t = _active
     if t is not None:
         t.instant(name, **args)
+    cb = _instant_observer
+    if cb is not None:
+        cb(name, args)
 
 
 def counter(name: str, **series) -> None:

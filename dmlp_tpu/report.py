@@ -76,6 +76,33 @@ def _roofline_rows(ledger: Dict[str, Any]) -> List[str]:
     return rows
 
 
+def _memory_rows(ledger: Dict[str, Any]) -> List[str]:
+    """Memory section: the telemetry-era peak-HBM watermark series —
+    analytic model, measured watermark (basis-labeled), the
+    model-vs-measured delta, and the telemetry overhead A/B. Explicit
+    ``mem_stats_unavailable`` marker series render as markers, never
+    vanish."""
+    rows = []
+    for name, pts in sorted(ledger.get("series", {}).items()):
+        low = name.lower()
+        if not (low.startswith("telemetry/") and (
+                "peak_hbm" in low or "mem_" in low
+                or "overhead" in low or "watermark" in low)):
+            continue
+        for p in pts:
+            val = p["value"]
+            unit = ""
+            if "bytes" in low and isinstance(val, (int, float)) \
+                    and val > 1 << 20:
+                unit = f" ({val / (1 << 20):.1f} MiB)"
+            rows.append(
+                f"- `{name}` r{p.get('round', '?')}: {_fmt(val)}{unit}"
+                + (f" ({p['device']})"
+                   if p.get("device") not in (None, "unspecified")
+                   else ""))
+    return rows
+
+
 def render_markdown(ledger: Dict[str, Any]) -> str:
     cov = ledger["coverage"]
     lines = ["# dmlp_tpu perf ledger", ""]
@@ -116,6 +143,14 @@ def render_markdown(ledger: Dict[str, Any]) -> str:
     if roof:
         lines += ["", "## Roofline & observability-cost records", ""]
         lines += roof
+
+    mem = _memory_rows(ledger)
+    if mem:
+        lines += ["", "## Memory", "",
+                  "Peak-HBM watermarks: analytic model (obs.memwatch) "
+                  "vs measured basis, plus the telemetry overhead A/B "
+                  "(tools/telemetry_smoke.py).", ""]
+        lines += mem
     lines.append("")
     return "\n".join(lines)
 

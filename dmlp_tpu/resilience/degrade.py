@@ -54,6 +54,11 @@ def _rung_context(engine, rung: str):
     duration."""
     prev = getattr(engine, "_degrade_rung", "fused")
     engine._degrade_rung = rung
+    # Live rung gauge: numeric ladder position (0 = fused ... 4 = host)
+    # so a scrape mid-incident sees WHERE the solve currently sits.
+    from dmlp_tpu.obs import telemetry
+    telemetry.registry().gauge("resilience.degrade_rung").set(
+        RUNGS.index(rung))
     try:
         if rung == "heuristic":
             from dmlp_tpu.tune import cache as tune_cache
@@ -95,6 +100,8 @@ def run_ladder(engine, inp, solve: Callable):
             nxt = RUNGS[i + 1]
             stats.record_degradation(rung, nxt)
             from dmlp_tpu.obs import trace as obs_trace
+            # The instant also lands in the flight recorder when a
+            # telemetry session is active (obs.trace instant observer).
             obs_trace.instant("resilience.degrade", frm=rung, to=nxt,
                               error=str(e)[:200])
     raise AssertionError("unreachable: the host rung returns or raises")

@@ -109,8 +109,21 @@ def call_with_retry(op: Callable, site: str,
         try:
             return op()
         except Exception as e:
-            if classify_fn(e) != "transient" \
-                    or attempt + 1 >= policy.attempts:
+            clc = classify_fn(e)
+            if clc != "transient" or attempt + 1 >= policy.attempts:
+                # Post-mortem evidence BEFORE the raise unwinds: a
+                # fatal-classified (or retries-exhausted) fault dumps
+                # the flight recorder while the last spans/events are
+                # still in the ring (no-op without a telemetry session;
+                # oom propagates to the ladder, which is recovery, not
+                # death — event only, no dump).
+                from dmlp_tpu.obs import telemetry
+                telemetry.flight_fault(
+                    site=site, classification=clc,
+                    error=type(e).__name__,
+                    dump=clc == "fatal" or (clc == "transient"
+                                            and attempt + 1
+                                            >= policy.attempts))
                 raise
             delay = backoff_ms(policy, site, attempt)
             stats.record_retry(site)

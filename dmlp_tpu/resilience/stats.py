@@ -1,100 +1,101 @@
 """Process-wide resilience accounting — the RunRecord/metrics feed.
 
-One module-level :class:`ResilienceStats` collects what the resilience
-layer actually did during a run (retries taken, degradation-ladder
-steps, faults the injection framework fired, train rollbacks,
-supervision restarts), mirroring the obs counters' install/collect
-shape: the engines and wrappers record unconditionally (cheap integer
-bumps), emitters snapshot once per run into the metrics summary /
-RunRecord ``resilience`` block, and the chaos harness asserts recovery
-was *visible*, not silent.
+As of the telemetry round these counters live in the ONE process-wide
+metrics registry (:data:`dmlp_tpu.obs.telemetry.REGISTRY`) instead of a
+private dict: the ``resilience.*`` counters are the same objects a live
+scrape (``--telemetry``), the flight recorder, and the end-of-run
+``resilience`` block in metrics summaries all read — one source of
+truth, no end-of-run copy that can drift from what a mid-run observer
+saw. The record hooks stay cheap unconditional integer bumps (the
+registry is stdlib-only and always present; *export* is what
+``--telemetry`` opts into), and :func:`snapshot` keeps its exact
+historical shape so RunRecords and the chaos harness are unchanged.
 
-Import-light by design (stdlib only): every resilience hook sits on a
-hot path that must cost nothing when nothing goes wrong.
+Only the ordered degradation *transition list* stays module-local: the
+chaos harness asserts the ladder's step sequence, and a labeled counter
+keeps counts, not order (the registry carries those counts too, under
+``resilience.degradations``).
 """
 
 from __future__ import annotations
 
-import dataclasses
 import threading
-from typing import Dict, List
+from typing import List
 
-
-@dataclasses.dataclass
-class ResilienceStats:
-    """Counters for one process's resilience activity."""
-
-    retries: int = 0
-    rollbacks: int = 0
-    restarts: int = 0
-    timeouts: int = 0
-    faults_injected: int = 0
-    degradations: List[str] = dataclasses.field(default_factory=list)
-    retry_sites: Dict[str, int] = dataclasses.field(default_factory=dict)
-
-    def any_activity(self) -> bool:
-        return bool(self.retries or self.rollbacks or self.restarts
-                    or self.timeouts or self.faults_injected
-                    or self.degradations)
-
+from dmlp_tpu.obs.telemetry import REGISTRY
 
 _lock = threading.Lock()
-_stats = ResilienceStats()
+_degradations: List[str] = []   # ordered transitions (counts mirror the
+#                                 resilience.degradations counter labels)
+
+
+def _counters() -> dict:
+    """The resilience counter set, registered once per name (literal
+    snake_case dotted names — check rule R6)."""
+    return {
+        "retries": REGISTRY.counter("resilience.retries"),
+        "rollbacks": REGISTRY.counter("resilience.rollbacks"),
+        "restarts": REGISTRY.counter("resilience.restarts"),
+        "timeouts": REGISTRY.counter("resilience.timeouts"),
+        "faults_injected": REGISTRY.counter("resilience.faults_injected"),
+        "degradations": REGISTRY.counter("resilience.degradations"),
+    }
 
 
 def reset() -> None:
-    global _stats
     with _lock:
-        _stats = ResilienceStats()
+        _degradations.clear()
+    REGISTRY.reset(prefix="resilience")
 
 
 def record_retry(site: str) -> None:
-    with _lock:
-        _stats.retries += 1
-        _stats.retry_sites[site] = _stats.retry_sites.get(site, 0) + 1
+    REGISTRY.counter("resilience.retries").inc(label=site)
 
 
 def record_degradation(frm: str, to: str) -> None:
     with _lock:
-        _stats.degradations.append(f"{frm}->{to}")
+        _degradations.append(f"{frm}->{to}")
+    REGISTRY.counter("resilience.degradations").inc(label=f"{frm}->{to}")
 
 
 def record_fault(site: str, kind: str) -> None:
-    with _lock:
-        _stats.faults_injected += 1
+    REGISTRY.counter("resilience.faults_injected").inc(label=kind)
 
 
 def record_rollback() -> None:
-    with _lock:
-        _stats.rollbacks += 1
+    REGISTRY.counter("resilience.rollbacks").inc()
 
 
 def record_restart() -> None:
-    with _lock:
-        _stats.restarts += 1
+    REGISTRY.counter("resilience.restarts").inc()
 
 
 def record_timeout(site: str) -> None:
-    with _lock:
-        _stats.timeouts += 1
+    REGISTRY.counter("resilience.timeouts").inc(label=site)
 
 
 def any_activity() -> bool:
-    with _lock:
-        return _stats.any_activity()
+    c = _counters()
+    return any(c[name].total() for name in
+               ("retries", "rollbacks", "restarts", "timeouts",
+                "faults_injected", "degradations"))
 
 
 def snapshot() -> dict:
     """A JSON-ready copy of the counters — the ``resilience`` block the
     metrics summary and RunRecords carry. Always includes every field
-    so consumers (the chaos harness) can assert zeros explicitly."""
+    so consumers (the chaos harness) can assert zeros explicitly. Reads
+    the REGISTRY (the telemetry scrape's source), not a private dict."""
+    c = _counters()
     with _lock:
-        return {
-            "retries": _stats.retries,
-            "rollbacks": _stats.rollbacks,
-            "restarts": _stats.restarts,
-            "timeouts": _stats.timeouts,
-            "faults_injected": _stats.faults_injected,
-            "degradations": list(_stats.degradations),
-            "retry_sites": dict(_stats.retry_sites),
-        }
+        degr = list(_degradations)
+    return {
+        "retries": int(c["retries"].total()),
+        "rollbacks": int(c["rollbacks"].total()),
+        "restarts": int(c["restarts"].total()),
+        "timeouts": int(c["timeouts"].total()),
+        "faults_injected": int(c["faults_injected"].total()),
+        "degradations": degr,
+        "retry_sites": {k: int(v)
+                        for k, v in c["retries"].by_label().items()},
+    }

@@ -159,6 +159,12 @@ def run_supervised(make_cluster: Callable[[int], List[List[str]]],
                                    "ok": failure == "",
                                    **({"failure": failure} if failure
                                       else {})})
+        if failure:
+            # Flight-recorder evidence for the post-mortem (no-op
+            # without a telemetry session): which launch died and why.
+            from dmlp_tpu.obs import telemetry
+            telemetry.flight_event("supervise.launch_failed",
+                                   attempt=attempt, reason=failure)
         if failure == "":
             with open(os.path.join(workdir, f"rank0.a{attempt}.out"),
                       "rb") as f:

@@ -531,6 +531,12 @@ def main(argv=None) -> int:
     p.add_argument("--trace", metavar="FILE", default=None,
                    help="write a Perfetto/Chrome-trace JSON of the run's "
                         "step/checkpoint spans to FILE (obs.trace)")
+    p.add_argument("--telemetry", metavar="FILE", default=None,
+                   help="live telemetry (obs.telemetry): periodic "
+                        "OpenMetrics snapshot rewrite of FILE (step "
+                        "latency histograms, device-memory watermarks, "
+                        "resilience counters) + crash flight recorder "
+                        "(FLIGHT_*.json next to FILE)")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--sanitize", action="store_true",
                    help="wrap every train step in jax.transfer_guard("
@@ -559,10 +565,14 @@ def main(argv=None) -> int:
     if args.mesh:
         mesh_shape = tuple(int(d) for d in args.mesh.split(","))
     tracer = None
+    rs_stats.reset()   # resets the registry's resilience.* counters too
+    telemetry_session = None
+    if args.telemetry:
+        from dmlp_tpu.obs import telemetry
+        telemetry_session = telemetry.start(path=args.telemetry)
     if args.trace:
         from dmlp_tpu.obs import trace as obs_trace
         tracer = obs_trace.install(obs_trace.Tracer())
-    rs_stats.reset()
     schedule = rs_inject.install_from_env(args.faults)
     final_state = None
     try:
@@ -584,6 +594,13 @@ def main(argv=None) -> int:
                 pp_schedule=args.pp_schedule,
                 n_virtual=args.virtual_stages,
                 sanitize=args.sanitize, nan_guard=args.nan_guard)
+    except Exception:
+        # Exception, not BaseException: a SystemExit/KeyboardInterrupt
+        # is not a crash (cli.py has the same rule).
+        if telemetry_session is not None:
+            from dmlp_tpu.obs import telemetry
+            telemetry.dump_on_crash("crash")
+        raise
     finally:
         if schedule is not None:
             rs_inject.write_log_if_requested()
@@ -592,6 +609,8 @@ def main(argv=None) -> int:
             from dmlp_tpu.obs import trace as obs_trace
             tracer.write(args.trace)
             obs_trace.uninstall()
+        if telemetry_session is not None:
+            telemetry_session.close()
     if args.record:
         from dmlp_tpu.obs.run import (RunRecord, current_device,
                                       round_from_name)
@@ -601,6 +620,24 @@ def main(argv=None) -> int:
         if args.metrics_file:
             artifacts["metrics"] = args.metrics_file
         rec_metrics = dict(last)
+        # Analytic per-device peak-HBM model for this run's step
+        # (obs.memwatch train term set) + watermark reconcile — the mem
+        # block carries the explicit marker where the backend reports
+        # no memory.
+        try:
+            from dmlp_tpu.obs import memwatch
+            model = memwatch.train_step_model(
+                [int(d) for d in args.dims.split(",")], args.batch,
+                optimizer=args.optimizer, mesh_shape=mesh_shape,
+                compute_dtype=args.compute_dtype)
+            # The (closed) session's sampler keeps its tracked peaks;
+            # without a session, fall back to a one-shot basis.
+            measured = (telemetry_session.sampler.measured_peak()
+                        if telemetry_session is not None
+                        else memwatch.measured_watermark())
+            rec_metrics["mem"] = memwatch.reconcile(model, measured)
+        except Exception:  # check: no-retry — obs never fails the run
+            pass
         if final_state is not None:
             # Bitwise state fingerprint: the chaos harness proves a
             # NaN-faulted run resumed step-identically by comparing

@@ -685,6 +685,89 @@ class TestR5Resilient:
         assert run_check(tmp_path, ["R5"]) == []
 
 # ---------------------------------------------------------------------------
+# R6 — telemetry metric-name contract (obs.telemetry registry)
+# ---------------------------------------------------------------------------
+
+
+class TestR6MetricNames:
+    def test_r601_fstring_name_caught(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/obs/x.py", """
+            from dmlp_tpu.obs.telemetry import REGISTRY
+            def f(site):
+                REGISTRY.counter(f"retries.{site}").inc()
+        """)
+        assert "R601" in rules_of(run_check(tmp_path, ["R6"]))
+
+    def test_r601_variable_name_caught(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/obs/x.py", """
+            from dmlp_tpu.obs import telemetry
+            def f(name):
+                telemetry.registry().gauge(name).set(1)
+        """)
+        assert "R601" in rules_of(run_check(tmp_path, ["R6"]))
+
+    def test_r601_camelcase_literal_caught(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/obs/x.py", """
+            from dmlp_tpu.obs.telemetry import REGISTRY
+            REGISTRY.histogram("SolveLatencyMs")
+        """)
+        assert "R601" in rules_of(run_check(tmp_path, ["R6"]))
+
+    def test_r601_literal_dotted_snake_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/obs/x.py", """
+            from dmlp_tpu.obs.telemetry import REGISTRY
+            def f(site):
+                REGISTRY.counter("engine.retries").inc(label=site)
+                REGISTRY.gauge("mem.device.bytes_in_use").set(1)
+                REGISTRY.histogram("span.latency_ms").observe(2.5)
+        """)
+        assert run_check(tmp_path, ["R6"]) == []
+
+    def test_r601_annotation_silences_deliberate_seam(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/obs/x.py", """
+            from dmlp_tpu.obs.telemetry import REGISTRY
+            def f(safe):
+                h = REGISTRY.histogram(safe + ".ms")  # check: allow-metric-name
+                h.observe(1.0)
+        """)
+        assert run_check(tmp_path, ["R6"]) == []
+
+    def test_r602_conflicting_kinds_cross_module(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/obs/a.py", """
+            from dmlp_tpu.obs.telemetry import REGISTRY
+            REGISTRY.counter("engine.solves")
+        """)
+        write(tmp_path, "dmlp_tpu/obs/b.py", """
+            from dmlp_tpu.obs.telemetry import REGISTRY
+            REGISTRY.gauge("engine.solves")
+        """)
+        fs = run_check(tmp_path, ["R6"])
+        assert "R602" in rules_of(fs)
+
+    def test_r602_same_kind_many_sites_clean(self, tmp_path):
+        # get-or-create is the contract: one name, one kind, any
+        # number of use sites.
+        write(tmp_path, "dmlp_tpu/obs/a.py", """
+            from dmlp_tpu.obs.telemetry import REGISTRY
+            REGISTRY.counter("engine.solves")
+        """)
+        write(tmp_path, "dmlp_tpu/obs/b.py", """
+            from dmlp_tpu.obs.telemetry import REGISTRY
+            REGISTRY.counter("engine.solves").inc()
+        """)
+        assert run_check(tmp_path, ["R6"]) == []
+
+    def test_non_registry_receiver_out_of_scope(self, tmp_path):
+        # A collections.Counter-style .counter attr on a non-registry
+        # object must not trip the rule.
+        write(tmp_path, "dmlp_tpu/obs/x.py", """
+            def f(store, name):
+                store.counter(name)
+        """)
+        assert run_check(tmp_path, ["R6"]) == []
+
+
+# ---------------------------------------------------------------------------
 # R0 — hygiene (the ruff-subset fallback behind make lint)
 # ---------------------------------------------------------------------------
 
