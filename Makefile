@@ -6,7 +6,8 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
 .PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
-	perf-gate check lint chaos-smoke telemetry-smoke clean
+	perf-gate check lint chaos-smoke telemetry-smoke serve-smoke \
+	serve-bench clean
 
 all: native
 
@@ -16,7 +17,7 @@ native/_fastparse.so: native/fastparse.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
 test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint \
-	chaos-smoke telemetry-smoke
+	chaos-smoke telemetry-smoke serve-smoke
 	python -m pytest tests/ -q
 
 # Static analysis + runtime-sanitizer smoke (README "Static analysis &
@@ -162,6 +163,32 @@ telemetry-smoke:
 	JAX_PLATFORMS=cpu python tools/telemetry_smoke.py \
 	  --out outputs/telemetry \
 	  --record outputs/telemetry/TELEMETRY_SMOKE.jsonl
+
+# Online-serving smoke (README "Serving"): the real daemon subprocess
+# on a scratch corpus — warmed shape buckets with the cold-start number
+# in the ready file, a mixed-(nq, k) trace replayed over concurrent
+# connections with every response byte-identical to the golden oracle,
+# the compile counter pinned across the replay (no per-request
+# recompilation), a valid OpenMetrics scrape from --telemetry-port, an
+# injected memory squeeze shed by admission control (visible rejection,
+# no ladder degradation), wire ingestion verified against the grown
+# corpus, and a SIGTERM drain that exits 0 with no flight dump — with
+# the serve RunRecord round-tripped through the perf ledger.
+serve-smoke:
+	mkdir -p outputs/serve
+	rm -f outputs/serve/SERVE_SMOKE.jsonl
+	JAX_PLATFORMS=cpu python tools/serve_smoke.py --out outputs/serve \
+	  --record outputs/serve/SERVE_SMOKE.jsonl
+
+# Serving throughput bench (not in `make test`; emits the SERVE_rNN
+# ledger rounds): replay inputs/serve_trace1.jsonl against the daemon
+# in interleaved gate-carry on/off arms. On a TPU host drop
+# JAX_PLATFORMS and keep the pallas flags.
+serve-bench:
+	mkdir -p outputs
+	python -m dmlp_tpu.bench serve --reps 2 \
+	  --metrics outputs/SERVE_BENCH.jsonl \
+	  --serve-flags "--pallas --select extract --data-block 12800"
 
 clean:
 	rm -f native/_fastparse.so

@@ -15,8 +15,11 @@ The TPU-native run_bench.sh. Per config (configs.py):
 
 from __future__ import annotations
 
+import json
 import os
 import re
+import statistics
+import sys
 import time
 from typing import Optional, TextIO
 
@@ -759,6 +762,188 @@ def _append_run_record(record_path: str, cfg: BenchConfig, res: dict,
               round=round_from_name(record_path)).append_jsonl(record_path)
 
 
+def run_serve(base_dir: str = ".", trace_path: Optional[str] = None,
+              reps: int = 2, record_path: Optional[str] = None,
+              timeout_s: float = 600.0, connections: int = 4,
+              max_batch_queries: int = 64,
+              extra_flags: Optional[list] = None,
+              out: TextIO = sys.stdout) -> dict:
+    """Serve mode: replay a recorded mixed-(nq, k) query trace against
+    the real daemon (``python -m dmlp_tpu.serve`` subprocess) in
+    interleaved gate-carry ON/OFF arms, and emit ONE schema-2 RunRecord
+    (kind "serve" -> ledger ``serve/...`` series) with sustained
+    request/query throughput, client-side latency quantiles, raw
+    per-arm sample lists, and the warm-up A/B's gated-block fractions.
+
+    Hard assertions, not best-effort: every response must match the
+    float64 golden oracle byte-for-byte, both arms must match each
+    other, the daemon's compile counter must not move between ready
+    and drain (no per-request recompilation), and SIGTERM must drain
+    to exit 0 with no flight-recorder dump."""
+    import subprocess
+
+    from dmlp_tpu.io.grammar import parse_input_text
+    from dmlp_tpu.obs.run import RunRecord, round_from_name
+    from dmlp_tpu.serve import client as serve_client
+
+    trace_path = trace_path or os.path.join(base_dir, "inputs",
+                                            "serve_trace1.jsonl")
+    header, reqs = serve_client.load_trace(trace_path)
+    outputs = os.path.join(base_dir, "outputs", "serve_bench")
+    os.makedirs(outputs, exist_ok=True)
+    corpus_txt = serve_client.corpus_text(header)
+    corpus_path = os.path.abspath(os.path.join(outputs, "corpus.in"))
+    with open(corpus_path, "w") as f:
+        f.write(corpus_txt)
+    corpus = parse_input_text(corpus_txt)
+    golden = serve_client.golden_reference(corpus, header, reqs)
+    golden_text = serve_client.contract_text(golden)
+    # Warm every shape bucket the replay can hit BEFORE ready — only
+    # then is the compile-counter assertion below meaningful.
+    warm = serve_client.warm_buckets_for_trace(reqs, max_batch_queries)
+    warm_spec = ",".join(f"{nq}x{k}" for nq, k in warm)
+
+    arm_results: dict = {"on": [], "off": []}
+    cold_ms: list = []
+    res: dict = {"trace": trace_path, "requests": len(reqs),
+                 "queries": int(sum(int(r["nq"]) for r in reqs)),
+                 "checksums_match": True}
+    for rep in range(max(reps, 1)):
+        # Interleave arm order per rep (the repo's A/B weathering
+        # methodology: neither arm systematically runs first).
+        arms = ("on", "off") if rep % 2 == 0 else ("off", "on")
+        for arm in arms:
+            tag = f"rep{rep}_{arm}"
+            ready = os.path.join(outputs, f"ready_{tag}.json")
+            errlog = os.path.join(outputs, f"daemon_{tag}.err")
+            if os.path.exists(ready):
+                os.remove(ready)
+            # --telemetry arms the session (and hence the flight
+            # recorder, whose dump dir is the snapshot file's dir) so
+            # the no-flight-dump drain assertion below has teeth.
+            cmd = [sys.executable, "-m", "dmlp_tpu.serve",
+                   "--corpus", corpus_path, "--port", "0",
+                   "--ready-file", ready, "--gate-carry", arm,
+                   "--warm-buckets", warm_spec,
+                   "--max-batch-queries", str(max_batch_queries),
+                   "--telemetry",
+                   os.path.join(outputs, f"telemetry_{tag}.prom"),
+                   "--tick-ms", "2"] + list(extra_flags or [])
+            # A crash in a PREVIOUS invocation may have left flight
+            # dumps here; clear them or the no-dump assertion below
+            # would fail every later orderly run forever.
+            serve_client.clear_flight_dumps(outputs)
+            with open(errlog, "w") as ef:
+                proc = subprocess.Popen(cmd, stderr=ef,
+                                        stdout=subprocess.DEVNULL)
+            try:
+                ready_doc = serve_client.await_ready(
+                    proc, ready, timeout_s=timeout_s, errlog=errlog)
+                port = ready_doc["port"]
+                t0 = time.perf_counter()
+                responses = serve_client.replay(
+                    port, header, reqs, connections=connections)
+                wall_s = time.perf_counter() - t0
+                bad = [r for r in responses if not r.get("ok")]
+                if bad:
+                    raise RuntimeError(
+                        f"serve replay ({tag}): {len(bad)} failed "
+                        f"responses, first: {bad[0]}")
+                text = serve_client.contract_text(
+                    [r["checksums"] for r in responses])
+                if text != golden_text:
+                    res["checksums_match"] = False
+                    raise RuntimeError(
+                        f"serve replay ({tag}): responses differ from "
+                        "the golden oracle")
+                cli = serve_client.ServeClient(port)
+                stats = cli.stats()["stats"]
+                cli.close()
+                if stats["engine"]["compile_count"] != \
+                        ready_doc["compile_count"]:
+                    raise RuntimeError(
+                        f"serve replay ({tag}): compile count moved "
+                        f"{ready_doc['compile_count']} -> "
+                        f"{stats['engine']['compile_count']} — a "
+                        "request recompiled")
+                serve_client.sigterm_drain(proc, errlog=errlog)
+                flights = serve_client.flight_dumps(outputs)
+                if flights:
+                    raise RuntimeError(
+                        f"orderly drain left flight dumps: {flights}")
+                arm_results[arm].append({
+                    "wall_s": wall_s,
+                    "requests_per_sec": len(reqs) / wall_s,
+                    "queries_per_sec": res["queries"] / wall_s,
+                    "latency_ms": sorted(r["client_ms"]
+                                         for r in responses),
+                    "gated_fraction":
+                        stats["engine"]["last_gated_fraction"],
+                })
+                cold_ms.append(ready_doc["cold_start_compile_ms"])
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=30)
+
+    def _q(sorted_ms: list, q: float) -> float:
+        return sorted_ms[min(int(q * (len(sorted_ms) - 1) + 0.5),
+                             len(sorted_ms) - 1)]
+
+    metrics: dict = {
+        "requests": len(reqs), "trace_queries": res["queries"],
+        "cold_start_compile_ms": statistics.median(cold_ms),
+        "cold_start_compile_ms_reps": cold_ms,
+        "connections": connections,
+    }
+    for arm, runs in arm_results.items():
+        rps = [round(r["requests_per_sec"], 3) for r in runs]
+        lat = sorted(ms for r in runs for ms in r["latency_ms"])
+        metrics[f"requests_per_sec_carry_{arm}"] = statistics.median(rps)
+        metrics[f"requests_per_sec_carry_{arm}_reps"] = rps
+        metrics[f"queries_per_sec_carry_{arm}"] = statistics.median(
+            [round(r["queries_per_sec"], 3) for r in runs])
+        metrics[f"request_latency_p50_ms_carry_{arm}"] = round(
+            _q(lat, 0.50), 3)
+        metrics[f"request_latency_p95_ms_carry_{arm}"] = round(
+            _q(lat, 0.95), 3)
+        metrics[f"carry_{arm}_latency_ms"] = [round(v, 3) for v in lat]
+        gf = [r["gated_fraction"] for r in runs
+              if r["gated_fraction"] is not None]
+        if gf:
+            metrics[f"gated_fraction_carry_{arm}"] = round(
+                statistics.median(gf), 6)
+            metrics[f"gated_fraction_carry_{arm}_reps"] = gf
+    res.update(metrics)
+    out.write(
+        f"Serve bench: {len(reqs)} requests x {reps} rep(s)/arm, "
+        f"carry-on {metrics['requests_per_sec_carry_on']} req/s vs "
+        f"carry-off {metrics['requests_per_sec_carry_off']} req/s, "
+        f"p50 {metrics['request_latency_p50_ms_carry_on']} ms, "
+        "all arms byte-identical to the golden oracle\n")
+    if record_path:
+        RunRecord(
+            kind="serve", tool="dmlp_tpu.bench",
+            config={"trace": os.path.basename(trace_path),
+                    "corpus": header["corpus"],
+                    "connections": connections, "reps": reps,
+                    "flags": list(extra_flags or [])},
+            metrics=metrics, round=round_from_name(record_path),
+            artifacts={"trace": trace_path},
+            device=_serve_device()).append_jsonl(record_path)
+    res["ok"] = True
+    return res
+
+
+def _serve_device() -> Optional[str]:
+    """Device kind for the serve RunRecord envelope — subprocesses did
+    the solving, so only report what the environment pins (touching
+    jax.devices() here could dial a TPU the daemons owned)."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return "cpu"
+    return None
+
+
 def reference_binary_fields(cap_path: str, config_id: int,
                             engine_ms: float) -> dict:
     """Annotation fields comparing an engine time against the captured
@@ -790,7 +975,9 @@ def main(argv=None) -> int:
     import sys
 
     p = argparse.ArgumentParser(prog="dmlp_tpu.bench", description=__doc__)
-    p.add_argument("config", help="1|2|3|4|5|all")
+    p.add_argument("config", help="1|2|3|4|5|all|serve ('serve' "
+                                  "replays --serve-trace against the "
+                                  "resident daemon)")
     p.add_argument("--mode", default=None,
                    choices=[None, "single", "sharded", "ring"])
     p.add_argument("--fast", action="store_true",
@@ -839,7 +1026,25 @@ def main(argv=None) -> int:
                         "engine_ms_fused / engine_ms_two_pass (+ raw "
                         "rep lists) in the config's RunRecord "
                         "(single-process configs)")
+    p.add_argument("--serve-trace", metavar="FILE", default=None,
+                   help="recorded query trace for the serve mode "
+                        "(default inputs/serve_trace1.jsonl)")
+    p.add_argument("--serve-connections", type=int, default=4,
+                   help="concurrent replay connections (micro-batching "
+                        "coalesces across them)")
+    p.add_argument("--serve-flags", default="",
+                   help="extra daemon flags, space-separated (e.g. "
+                        "'--pallas --data-block 12800')")
     args = p.parse_args(argv)
+
+    if args.config == "serve":
+        res = run_serve(base_dir=args.base_dir,
+                        trace_path=args.serve_trace,
+                        reps=args.reps, record_path=args.metrics,
+                        timeout_s=args.timeout,
+                        connections=args.serve_connections,
+                        extra_flags=args.serve_flags.split() or None)
+        return 0 if res.get("ok") else 1
 
     ids = list(BENCH_CONFIGS) if args.config == "all" else [int(args.config)]
     ok = True

@@ -262,6 +262,11 @@ def _runrecord_series_name(rec: RunRecord, key: str) -> str:
       family for both emitters so the peak-HBM watermark,
       model-vs-measured delta, and telemetry-overhead series stay
       round-comparable regardless of which tool wrote the round;
+    - serving records (kind "serve": the daemon's periodic/final
+      snapshot_record and the bench harness's trace-replay A/B) key
+      ``serve/<metric>`` — one family for both emitters so sustained
+      QPS, latency quantiles, and cold-start compile time stay
+      round-comparable (gated by tools/perf_gate.py);
     - tools.trainbench_moe continues ``trainbench/moe/<arm>/<metric>``
       (``a2a_median_ms`` -> ``trainbench/moe/a2a/median_ms``);
     - tools.bench_offload_ladder continues
@@ -275,6 +280,8 @@ def _runrecord_series_name(rec: RunRecord, key: str) -> str:
     if rec.kind == "telemetry":
         cfg_tag = f"/config{cid}" if cid is not None else ""
         return f"telemetry{cfg_tag}/{key}"
+    if rec.kind == "serve":
+        return f"serve/{key}"
     if rec.tool == "tools.trainbench_moe":
         m = re.match(r"(dense|a2a)_(.+)$", key)
         if m:

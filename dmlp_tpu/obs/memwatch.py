@@ -280,6 +280,32 @@ def train_step_model(dims, batch: int, optimizer: str = "sgd",
                    n_devices=max(dp, 1) * max(tp, 1))
 
 
+def serve_engine_model(capacity_rows: int, na: int,
+                       staging: str = "float32", qpad: int = 0,
+                       kcap: int = 0, extract_chunks: int = 0,
+                       chunk_rows: int = 0) -> Dict[str, Any]:
+    """Peak resident device bytes for the serving layer's
+    :class:`~dmlp_tpu.serve.engine.ResidentEngine`: the capacity-padded
+    resident corpus (+ labels/ids mask arrays), the extract path's
+    resident chunk copies when staged, and — when a micro-batch bucket
+    (qpad, kcap) is given — that batch's transient terms (padded query
+    block + double-buffered candidate lists). The admission controller
+    reads the corpus terms as the floor and prices each bucket's
+    marginal bytes on top."""
+    item = _staging_itemsize(staging)
+    terms: Dict[str, int] = {
+        "resident_corpus": capacity_rows * na * item,
+        "labels_ids": capacity_rows * 8,
+    }
+    if extract_chunks:
+        terms["extract_chunks"] = extract_chunks * chunk_rows * na * item
+    if qpad:
+        terms["query_blocks"] = qpad * na * item
+        terms["topk_carries"] = 2 * qpad * kcap * _TOPK_ITEMSIZE
+    return _finish(terms, kind="serve", capacity_rows=capacity_rows,
+                   staging=staging)
+
+
 def _finish(terms: Dict[str, int], **meta) -> Dict[str, Any]:
     out: Dict[str, Any] = {"model_schema": 1,
                            "terms": {k: int(v) for k, v in terms.items()},
@@ -297,6 +323,8 @@ def resident_bytes_model(kind: str, **params) -> Dict[str, Any]:
         return mesh_engine_model(mode=kind, **params)
     if kind == "train":
         return train_step_model(**params)
+    if kind == "serve":
+        return serve_engine_model(**params)
     raise ValueError(f"unknown workload kind {kind!r}")
 
 
@@ -306,6 +334,15 @@ def model_for_engine(engine, inp) -> Dict[str, Any]:
     solve will resolve."""
     p = inp.params
     kmax = int(inp.ks.max()) if p.num_queries else 1
+    if hasattr(engine, "capacity_rows"):      # serve.ResidentEngine
+        # bucket_plan is the ONE kcap derivation (matches what
+        # _build_bucket compiles — no drift between model and solve)
+        qpad, _kb, kcap = engine.bucket_plan(p.num_queries, kmax)
+        return serve_engine_model(
+            engine.capacity_rows, p.num_attrs, staging=engine._staging,
+            qpad=qpad, kcap=kcap,
+            extract_chunks=(engine._ex_nchunks if engine._chunks else 0),
+            chunk_rows=engine._ex_chunk_rows)
     if type(engine).__name__ == "SingleChipEngine":
         return single_engine_model(p.num_data, p.num_queries, p.num_attrs,
                                    kmax, config=engine.config,
@@ -377,6 +414,7 @@ def reconcile(model: Dict[str, Any],
 __all__ = [
     "RATIO_BOUNDS", "device_memory_stats", "live_array_bytes",
     "measured_watermark", "single_engine_model", "mesh_engine_model",
-    "train_step_model", "resident_bytes_model", "model_for_engine",
+    "train_step_model", "serve_engine_model", "resident_bytes_model",
+    "model_for_engine",
     "note_engine_model", "reconcile",
 ]

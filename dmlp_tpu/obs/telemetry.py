@@ -805,6 +805,7 @@ class TelemetrySession:
         self._port = port
         self._handle_signals = handle_signals
         self._closed = False
+        self._drain_hook = None
 
     def _activate(self) -> None:
         self.sampler.start()
@@ -830,7 +831,25 @@ class TelemetrySession:
         except ValueError:
             pass    # not the main thread: skip, dump-on-crash still works
 
+    def set_sigterm_drain(self, hook) -> None:
+        """Register a graceful-drain hook: while set, SIGTERM invokes
+        ``hook()`` (which should only set an event — signal context)
+        instead of dumping the flight ring and re-raising the kill. An
+        ORDERLY shutdown is not a crash: the serving daemon finishes
+        its in-flight micro-batches, flushes the final snapshot itself,
+        and exits clean with no FLIGHT artifact. Pass None to restore
+        the post-mortem behavior."""
+        self._drain_hook = hook
+
     def _on_sigterm(self, signum, frame):
+        hook = self._drain_hook
+        if hook is not None:
+            try:
+                self.flight.record("event", "sigterm_drain")
+                hook()
+            except Exception:  # check: no-retry — a failing hook must
+                pass           # not resurrect the kill mid-drain
+            return
         try:
             self.flight.record("event", "sigterm")
             self.flight.dump(self.flight_dir, "sigterm")

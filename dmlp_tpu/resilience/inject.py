@@ -76,6 +76,11 @@ SITES: Dict[str, str] = {
     "train.step": "one optimizer step (train.loop; nan faults poison "
                   "the step's loss so the NaN guard's rollback path "
                   "can be driven deterministically)",
+    "serve.admit": "serving-daemon admission decision "
+                   "(serve.admission.AdmissionController.decide; an oom "
+                   "fault here is the injected memory squeeze — the "
+                   "controller must SHED the request before any "
+                   "allocation, visibly, with no ladder degradation)",
 }
 
 KINDS = ("delay", "transient", "oom", "corrupt", "nan")

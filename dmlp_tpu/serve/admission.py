@@ -1,0 +1,186 @@
+"""Admission control: shed load BEFORE the allocator OOMs.
+
+The batch engines react to memory pressure after the fact (the
+resilience ladder catches RESOURCE_EXHAUSTED and degrades). A serving
+daemon can do better: the analytic peak-HBM model (obs.memwatch) knows
+what a micro-batch of a given shape bucket will make resident, and the
+telemetry sampler knows the live watermark — so the admission decision
+compares ``watermark + batch_bytes`` against the budget and REJECTS
+when the headroom is gone, before any allocation happens. A rejected
+request is a visible counter (``serve.rejected``) and a clean protocol
+error; the ladder stays the backstop for surprises, not the first
+responder.
+
+The injected memory squeeze (``make serve-smoke``'s chaos arm) drives
+this path deterministically: an ``oom`` fault at the ``serve.admit``
+site (resilience.inject) makes the controller behave as if the
+watermark had swallowed the budget — the daemon must shed, the
+rejection must land in the registry, and the degradation ladder must
+stay untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from dmlp_tpu.obs import memwatch, telemetry
+from dmlp_tpu.resilience import inject as rs_inject
+from dmlp_tpu.resilience.retry import classify
+
+#: decision verdicts
+ACCEPT = "accept"
+REJECT = "reject"
+
+
+class AdmissionController:
+    """Per-request accept/reject decisions for the serving daemon.
+
+    ``budget_bytes``: the device-memory budget admission defends.
+    ``None`` = auto: the backend's reported per-device ``bytes_limit``
+    sum when available, else memory-based shedding is OFF (an explicit
+    marker in :meth:`snapshot` — never a silent guess on backends that
+    report nothing, like this container's CPU).
+    """
+
+    def __init__(self, engine, budget_bytes: Optional[int] = None,
+                 max_queue_queries: int = 4096,
+                 max_request_queries: int = 1024,
+                 max_k: Optional[int] = None,
+                 batch_queries_cap: Optional[int] = None):
+        self.engine = engine
+        self.budget_bytes = (budget_bytes if budget_bytes is not None
+                             else self._auto_budget())
+        self.max_queue_queries = max_queue_queries
+        self.max_request_queries = max_request_queries
+        #: the batcher's per-micro-batch query cap — memory pricing is
+        #: against the COALESCED batch this request may join, not the
+        #: lone request (64 small admits must not OOM as one batch)
+        self.batch_queries_cap = batch_queries_cap or max_request_queries
+        self.max_k = min(max_k or engine.max_k, engine.max_k)
+        self.draining = False
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _auto_budget() -> Optional[int]:
+        """Device 0's reported ``bytes_limit`` — the resident engine is
+        single-chip, so summing every device's limit would overstate
+        the budget by the host's chip count and admission would keep
+        accepting while the one solve device OOMs."""
+        stats = memwatch.device_memory_stats()
+        if not stats or not stats[0]:
+            return None
+        limit = stats[0].get("bytes_limit")
+        return int(limit) if limit else None
+
+    # -- the memory model ------------------------------------------------------
+
+    def batch_bytes(self, nq: int, kmax: int) -> int:
+        """Marginal resident bytes a micro-batch of this shape bucket
+        adds on top of the resident corpus — the per-bucket terms of
+        ``memwatch.serve_engine_model`` at the engine's own
+        ``bucket_plan`` (the one kcap derivation), so the pricing
+        cannot drift from what the solve allocates."""
+        eng = self.engine
+        qpad, _kb, kcap = eng.bucket_plan(nq, kmax)
+        terms = memwatch.serve_engine_model(
+            eng.capacity_rows, eng.num_attrs, staging=eng._staging,
+            qpad=qpad, kcap=kcap)["terms"]
+        return int(terms["query_blocks"] + terms["topk_carries"])
+
+    def _resident_model_bytes(self) -> int:
+        """The corpus-only model total, cached — it only moves when the
+        extract chunks stage (every other input is fixed at engine
+        construction), and decide() runs under the batcher's queue
+        lock, so rebuilding the dict per request is pure hot-path
+        waste."""
+        chunks_staged = self.engine._chunks is not None
+        cached = getattr(self, "_model_cache", None)
+        if cached is not None and cached[0] == chunks_staged:
+            return cached[1]
+        model = memwatch.resident_bytes_model(
+            "serve", capacity_rows=self.engine.capacity_rows,
+            na=self.engine.num_attrs, staging=self.engine._staging,
+            extract_chunks=(self.engine._ex_nchunks
+                            if chunks_staged else 0),
+            chunk_rows=self.engine._ex_chunk_rows)
+        total = int(model["total_bytes"])
+        self._model_cache = (chunks_staged, total)
+        return total
+
+    def headroom_bytes(self) -> Optional[int]:
+        """Budget minus the max of (live watermark, modeled resident
+        set); None when no budget basis exists."""
+        if self.budget_bytes is None:
+            return None
+        sess = telemetry.session()
+        measured = (sess.sampler.measured_peak() if sess
+                    else memwatch.measured_watermark())
+        used = int(measured.get("bytes", 0) or 0)
+        used = max(used, self._resident_model_bytes())
+        return self.budget_bytes - used
+
+    # -- the decision ----------------------------------------------------------
+
+    def decide(self, nq: int, kmax: int, queued_queries: int,
+               queued_kmax: int = 0) -> Dict[str, Any]:
+        """One admission decision; returns ``{"verdict", "reason",
+        ...}`` and records it in the registry either way.
+        ``queued_queries``/``queued_kmax`` describe the work already
+        admitted and waiting: the memory check prices the micro-batch
+        this request would actually COALESCE into (bounded by the
+        batcher's cap), not the request alone."""
+        reg = telemetry.registry()
+        verdict, reason = ACCEPT, "ok"
+        if self.draining:
+            verdict, reason = REJECT, "draining"
+        elif nq < 1 or nq > self.max_request_queries:
+            verdict, reason = REJECT, "shape"
+        elif kmax < 1 or kmax > self.max_k:
+            verdict, reason = REJECT, "k_too_large"
+        elif queued_queries + nq > self.max_queue_queries:
+            verdict, reason = REJECT, "queue_full"
+        else:
+            squeeze = False
+            try:
+                rs_inject.fire("serve.admit", nq=nq, k=kmax)
+            except Exception as e:
+                # An injected RESOURCE_EXHAUSTED here IS the memory
+                # squeeze: treat the budget as swallowed. Anything else
+                # is a real bug and must propagate.
+                if classify(e) != "oom":
+                    raise
+                squeeze = True
+            if squeeze:
+                verdict, reason = REJECT, "injected_squeeze"
+            elif self.budget_bytes is not None:
+                # Priced only when a budget exists: decide() runs under
+                # the batcher's queue lock, and a no-budget backend
+                # (memory shedding off) must not pay the model per
+                # request for a comparison that can never fire.
+                headroom = self.headroom_bytes()
+                eff_nq = min(queued_queries + nq,
+                             max(self.batch_queries_cap, nq))
+                need = self.batch_bytes(eff_nq, max(kmax, queued_kmax))
+                reg.gauge("serve.headroom_bytes").set(headroom)
+                if need > headroom:
+                    verdict, reason = REJECT, "memory"
+        if verdict == ACCEPT:
+            reg.counter("serve.admitted").inc()
+        else:
+            reg.counter("serve.rejected").inc(label=reason)
+        return {"verdict": verdict, "reason": reason, "nq": nq, "k": kmax}
+
+    def snapshot(self) -> Dict[str, Any]:
+        reg = telemetry.registry()
+        return {
+            "budget_bytes": self.budget_bytes,
+            "memory_shedding": self.budget_bytes is not None,
+            "headroom_bytes": self.headroom_bytes(),
+            "max_k": self.max_k,
+            "max_request_queries": self.max_request_queries,
+            "max_queue_queries": self.max_queue_queries,
+            "admitted": reg.counter("serve.admitted").total(),
+            "rejected": reg.counter("serve.rejected").by_label(),
+            "draining": self.draining,
+        }

@@ -1,0 +1,236 @@
+"""Continuous micro-batching: coalesce whatever is queued each tick.
+
+Requests arrive one at a time (tiny nq each); the MXU wants full
+tiles. The :class:`MicroBatcher` drains the admission queue each tick
+into ONE padded micro-batch — variable (nq, k) requests concatenate,
+the combined shape buckets to the engine's power-of-two jit-cache
+buckets, and per-request results slice back out bit-identically (the
+solo solve over the same corpus produces the same bytes; both equal
+the golden oracle by the finalize/repair contract).
+
+Single consumer thread: the engine (and its ingest path) is driven by
+exactly one thread, so resident-buffer updates never race a solve.
+Requests complete through a per-request event; connection handlers
+block on it and write the response.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from dmlp_tpu.obs import telemetry
+from dmlp_tpu.obs.trace import span as obs_span
+from dmlp_tpu.serve.admission import ACCEPT, AdmissionController
+from dmlp_tpu.serve.engine import ResidentEngine
+
+#: default batcher tick: how long a lone request waits for company
+TICK_S = 0.002
+
+
+@dataclasses.dataclass
+class Request:
+    """One admitted unit of work. ``kind`` is "query" | "ingest";
+    ingest requests execute standalone between micro-batches (the one
+    batcher thread serializes them against solves)."""
+
+    kind: str
+    req_id: str = ""
+    query_attrs: Optional[np.ndarray] = None      # (nq, na) float64
+    ks: Optional[np.ndarray] = None               # (nq,) int32
+    labels: Optional[np.ndarray] = None           # ingest: (m,) int32
+    attrs: Optional[np.ndarray] = None            # ingest: (m, na) f64
+    debug: bool = False                           # echo neighbors/dists
+    t_enqueue: float = dataclasses.field(default_factory=time.monotonic)
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    results: Optional[List] = None                # QueryResults (local ids)
+    error: Optional[str] = None
+    latency_ms: Optional[float] = None
+    corpus_rows: Optional[int] = None             # ingest outcome
+
+    @property
+    def nq(self) -> int:
+        return 0 if self.ks is None else len(self.ks)
+
+    def complete(self, results=None, error=None, corpus_rows=None) -> None:
+        self.results = results
+        self.error = error
+        self.corpus_rows = corpus_rows
+        self.latency_ms = (time.monotonic() - self.t_enqueue) * 1e3
+        self.done.set()
+
+
+class MicroBatcher:
+    """The admission queue + the one batch-execution thread."""
+
+    def __init__(self, engine: ResidentEngine,
+                 admission: AdmissionController,
+                 max_batch_queries: int = 1024,
+                 tick_s: float = TICK_S):
+        self.engine = engine
+        self.admission = admission
+        self.max_batch_queries = max_batch_queries
+        self.tick_s = tick_s
+        self._queue: deque = deque()
+        self._queued_queries = 0
+        self._queued_kmax = 0     # max k among queued query requests
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.batches = 0
+
+    # -- producer side ---------------------------------------------------------
+
+    def submit(self, req: Request) -> Dict[str, Any]:
+        """Admission decision + enqueue; returns the decision dict.
+        Rejected requests complete immediately with the reason."""
+        if req.kind == "query":
+            kmax = int(req.ks.max()) if req.nq else 0
+            with self._cond:
+                decision = self.admission.decide(
+                    req.nq, kmax, self._queued_queries,
+                    queued_kmax=self._queued_kmax)
+                if decision["verdict"] == ACCEPT:
+                    self._queue.append(req)
+                    self._queued_queries += req.nq
+                    self._queued_kmax = max(self._queued_kmax, kmax)
+                    telemetry.registry().gauge("serve.queue_depth").set(
+                        self._queued_queries)
+                    self._cond.notify()
+            if decision["verdict"] != ACCEPT:
+                req.complete(error=f"rejected: {decision['reason']}")
+            return decision
+        # Ingest rides the same queue (serialized against solves) but
+        # skips the per-query admission gates; capacity errors surface
+        # at execution.
+        with self._cond:
+            if self.admission.draining:
+                req.complete(error="rejected: draining")
+                return {"verdict": "reject", "reason": "draining"}
+            self._queue.append(req)
+            self._cond.notify()
+        return {"verdict": ACCEPT, "reason": "ok"}
+
+    # -- consumer side ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop = False
+        self._thread = threading.Thread(target=self._run_loop,
+                                        name="serve-batcher", daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop the batcher thread. ``drain=True`` finishes everything
+        already queued first (the SIGTERM path); ``drain=False`` fails
+        queued requests with a shutdown error."""
+        with self._cond:
+            self._stop = True
+            if not drain:
+                while self._queue:
+                    self._queue.popleft().complete(error="shutdown")
+                self._queued_queries = 0
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None
+
+    def _collect(self) -> List[Request]:
+        """Block for work, then drain the queue up to the batch cap —
+        the 'coalesce whatever is queued each tick' core. A lone
+        request waits one tick for company before solving solo."""
+        with self._cond:
+            while not self._queue and not self._stop:
+                self._cond.wait(timeout=0.1)
+            if not self._queue:
+                return []
+            if not self._stop and self.tick_s > 0 \
+                    and self._queued_queries < self.max_batch_queries:
+                self._cond.wait(timeout=self.tick_s)
+            batch: List[Request] = []
+            total = 0
+            while self._queue:
+                head = self._queue[0]
+                if head.kind == "ingest":
+                    if batch:
+                        break          # solve what we have first
+                    self._queue.popleft()
+                    return [head]      # ingest executes standalone
+                if batch and total + head.nq > self.max_batch_queries:
+                    break
+                self._queue.popleft()
+                batch.append(head)
+                total += head.nq
+            self._queued_queries -= total
+            if self._queued_queries == 0:
+                self._queued_kmax = 0   # conservative: only reset when
+                #                         nothing queued remains
+            telemetry.registry().gauge("serve.queue_depth").set(
+                self._queued_queries)
+            return batch
+
+    def _run_loop(self) -> None:
+        while True:
+            batch = self._collect()
+            if not batch:
+                with self._cond:
+                    if self._stop and not self._queue:
+                        return
+                continue
+            if batch[0].kind == "ingest":
+                self._execute_ingest(batch[0])
+            else:
+                self._execute_batch(batch)
+
+    def _execute_ingest(self, req: Request) -> None:
+        try:
+            rows = self.engine.ingest(req.labels, req.attrs)
+            req.complete(corpus_rows=rows)
+        except Exception as e:  # check: no-retry — surfaced to the client
+            req.complete(error=f"{type(e).__name__}: {e}")
+
+    def _execute_batch(self, batch: List[Request]) -> None:
+        reg = telemetry.registry()
+        total = sum(r.nq for r in batch)
+        q = np.concatenate([r.query_attrs for r in batch])
+        ks = np.concatenate([r.ks for r in batch])
+        qpad, _ = self.engine.bucket_shape(
+            total, int(ks.max()) if total else 1)
+        t0 = time.perf_counter()
+        try:
+            with obs_span("serve.micro_batch", requests=len(batch),
+                          queries=total, qpad=qpad):
+                results = self.engine.solve_batch(q, ks)
+        except Exception as e:  # check: no-retry — batch fails visibly,
+            reg.counter("serve.batch_errors").inc()  # daemon survives
+            msg = f"{type(e).__name__}: {e}"
+            for r in batch:
+                r.complete(error=msg)
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        self.batches += 1
+        reg.counter("serve.batches").inc()
+        reg.histogram("serve.batch_latency_ms", unit="ms").observe(ms)
+        reg.histogram("serve.batch_queries").observe(total)
+        reg.gauge("serve.batch_fill").set(round(total / max(qpad, 1), 6))
+        off = 0
+        for r in batch:
+            sub = results[off:off + r.nq]
+            # Re-anchor query ids to the request (byte-identical to the
+            # solo solve of the same request over the same corpus).
+            local = [dataclasses.replace(qr, query_id=qr.query_id - off)
+                     for qr in sub]
+            off += r.nq
+            r.complete(results=local)
+            reg.counter("serve.requests_completed").inc()
+            reg.counter("serve.queries_completed").inc(r.nq)
+            reg.histogram("serve.request_latency_ms", unit="ms").observe(
+                (time.monotonic() - r.t_enqueue) * 1e3)

@@ -1,0 +1,347 @@
+"""The serving daemon: parse once, stage once, compile once, serve.
+
+``python -m dmlp_tpu.serve --corpus FILE`` builds a
+:class:`~dmlp_tpu.serve.engine.ResidentEngine` over the corpus file's
+data section, warms the shape buckets derived from its query section
+(plus ``--warm-buckets``), and serves the line-JSON protocol
+(:mod:`dmlp_tpu.serve.protocol`) on a localhost TCP port. Telemetry is
+the PR 9 substrate unchanged: ``--telemetry-port`` is the live
+OpenMetrics scrape surface, per-request latency lands in the
+registry's log-bucket histograms, and ``--record`` appends
+ledger-ingestible serve RunRecords (kind "serve" -> ``serve/...``
+series, gated by ``make perf-gate``).
+
+Shutdown contract (the graceful-drain satellite): SIGTERM (or an
+in-band ``drain`` op) stops admission ("draining" rejections), lets
+the batcher finish every in-flight and queued micro-batch, appends the
+final RunRecord, flushes the final telemetry snapshot, and exits 0 —
+an orderly drain leaves NO flight-recorder dump (crashes still do).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socketserver
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.io.grammar import KNNInput
+from dmlp_tpu.obs import telemetry
+from dmlp_tpu.serve import protocol
+from dmlp_tpu.serve.admission import AdmissionController
+from dmlp_tpu.serve.batching import MicroBatcher, Request
+from dmlp_tpu.serve.engine import ResidentEngine
+
+
+def default_warm_buckets(corpus: KNNInput) -> List[Tuple[int, int]]:
+    """Warm-up shapes: the corpus file's own query section is the
+    operator's declaration of expected traffic — bucket every (count,
+    k) it contains, plus the smallest bucket as the floor."""
+    out = [(1, 1)]
+    nq = corpus.params.num_queries
+    if nq:
+        out.append((nq, int(corpus.ks.max())))
+        out.append((1, int(corpus.ks.min())))
+    return out
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    """One connection: requests answered strictly in line order."""
+
+    def handle(self):  # noqa: D102 (socketserver API)
+        daemon: ServeDaemon = self.server.daemon
+        while True:
+            # Bounded read: readline(cap + 1) never buffers more than
+            # the cap, so an oversized request line cannot balloon the
+            # daemon's memory before rejection. A cap-exceeding read
+            # has lost line framing — reject and drop the connection.
+            raw = self.rfile.readline(protocol.MAX_LINE_BYTES + 1)
+            if not raw:
+                break
+            if len(raw) > protocol.MAX_LINE_BYTES:
+                self.wfile.write(protocol.encode(
+                    {"ok": False,
+                     "error": "request line exceeds the size cap"}))
+                break
+            try:
+                line = raw.decode("utf-8", errors="strict").strip()
+            except UnicodeDecodeError:
+                self.wfile.write(protocol.encode(
+                    {"ok": False, "error": "request is not UTF-8"}))
+                continue
+            if not line:
+                continue
+            # In-flight accounting brackets the RESPONSE WRITE, not
+            # just the solve: drain() waits for it, so a drained
+            # request's response actually reaches the client before
+            # the process exits (handler threads are daemonized).
+            daemon._track_inflight(+1)
+            try:
+                try:
+                    resp = daemon.handle_line(line)
+                except protocol.ProtocolError as e:
+                    resp = {"ok": False, "error": str(e)}
+                except Exception as e:  # check: no-retry — the
+                    # connection survives a bad request; solve-path
+                    # crashes are already surfaced per-request by the
+                    # batcher
+                    resp = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"}
+                self.wfile.write(protocol.encode(resp))
+                self.wfile.flush()
+            finally:
+                daemon._track_inflight(-1)
+            if resp.get("draining"):
+                break
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ServeDaemon:
+    """Lifecycle owner: engine + admission + batcher + TCP server +
+    telemetry session + drain choreography."""
+
+    def __init__(self, corpus: KNNInput, config: EngineConfig = None,
+                 port: int = 0, capacity: Optional[int] = None,
+                 gate_carry: bool = True,
+                 budget_bytes: Optional[int] = None,
+                 max_batch_queries: int = 1024,
+                 max_queue_queries: int = 4096,
+                 max_k: Optional[int] = None,
+                 tick_s: float = 0.002,
+                 telemetry_path: Optional[str] = None,
+                 telemetry_port: Optional[int] = None,
+                 record_path: Optional[str] = None,
+                 snapshot_every_s: float = 0.0,
+                 warm_buckets: Optional[List[Tuple[int, int]]] = None):
+        self.corpus = corpus
+        self.record_path = record_path
+        self.snapshot_every_s = snapshot_every_s
+        self.session = None
+        if telemetry_path or telemetry_port is not None:
+            # handle_signals stays ON (the session owns the handler);
+            # the daemon registers the clean-drain hook so an orderly
+            # SIGTERM drains instead of dumping a flight artifact.
+            self.session = telemetry.start(path=telemetry_path,
+                                           port=telemetry_port)
+        # The registry is process-global but stats()/snapshot_record()
+        # divide by THIS daemon's uptime: zero the serve.* counters so
+        # a second daemon lifetime in one process (tests, in-process
+        # embedding) doesn't inherit the first one's counts and feed
+        # inflated requests_per_sec into the ledger.
+        telemetry.registry().reset(prefix="serve")
+        self.engine = ResidentEngine(corpus, config or EngineConfig(),
+                                     capacity=capacity,
+                                     gate_carry=gate_carry)
+        self.admission = AdmissionController(
+            self.engine, budget_bytes=budget_bytes,
+            max_queue_queries=max_queue_queries,
+            max_request_queries=max_batch_queries, max_k=max_k,
+            batch_queries_cap=max_batch_queries)
+        self.batcher = MicroBatcher(self.engine, self.admission,
+                                    max_batch_queries=max_batch_queries,
+                                    tick_s=tick_s)
+        self._warm = (warm_buckets if warm_buckets is not None
+                      else default_warm_buckets(corpus))
+        self._drain_event = threading.Event()
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
+        self._server = _Server(("127.0.0.1", port), _Handler)
+        self._server.daemon = self
+        self.port = self._server.server_address[1]
+        self._server_thread: Optional[threading.Thread] = None
+        self._t_ready: Optional[float] = None
+        self.warmup_ms: Dict[str, float] = {}
+        if self.session is not None:
+            self.session.set_sigterm_drain(self._drain_event.set)
+        else:
+            import signal
+            try:
+                signal.signal(signal.SIGTERM,
+                              lambda s, f: self._drain_event.set())
+            except ValueError:
+                pass    # not the main thread (tests): drain op only
+
+    # -- startup ---------------------------------------------------------------
+
+    def start(self) -> None:
+        """Warm the buckets, then open for traffic."""
+        self.warmup_ms = self.engine.warmup(self._warm)
+        self.batcher.start()
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, name="serve-accept",
+            daemon=True)
+        self._server_thread.start()
+        self._t_ready = time.monotonic()
+        telemetry.registry().gauge("serve.ready").set(1)
+
+    def write_ready_file(self, path: str) -> None:
+        doc = {
+            "port": self.port, "pid": os.getpid(),
+            "cold_start_compile_ms": self.engine.cold_start_compile_ms,
+            "compile_count": self.engine.compile_count,
+            "buckets": self.engine.bucket_stats()["buckets"],
+            "warmup_ms": self.warmup_ms,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+
+    # -- request plumbing ------------------------------------------------------
+
+    def _track_inflight(self, delta: int) -> None:
+        with self._inflight_cond:
+            self._inflight += delta
+            if self._inflight <= 0:
+                self._inflight_cond.notify_all()
+
+    def _wait_inflight_drained(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        with self._inflight_cond:
+            while self._inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return      # give up, don't wedge the drain
+                self._inflight_cond.wait(timeout=left)
+
+    def handle_line(self, line: str) -> Dict[str, Any]:
+        obj = protocol.parse_request(line, self.corpus.params.num_attrs)
+        if isinstance(obj, dict):                 # control ops
+            if obj.get("op") == "stats":
+                return {"ok": True, "stats": self.stats()}
+            self._drain_event.set()               # "drain"
+            return {"ok": True, "draining": True}
+        req: Request = obj
+        self.batcher.submit(req)
+        req.done.wait()
+        if req.kind == "ingest":
+            return protocol.ingest_response(req)
+        return protocol.query_response(req)
+
+    def stats(self) -> Dict[str, Any]:
+        reg = telemetry.registry()
+        eng = self.engine
+        elapsed = (time.monotonic() - self._t_ready) \
+            if self._t_ready else 0.0
+        done = reg.counter("serve.requests_completed").total()
+        out = {
+            "protocol": protocol.PROTOCOL_VERSION,
+            "engine": eng.bucket_stats(),
+            "admission": self.admission.snapshot(),
+            "requests_completed": done,
+            "queries_completed":
+                reg.counter("serve.queries_completed").total(),
+            "batches": self.batcher.batches,
+            "uptime_s": round(elapsed, 3),
+            "requests_per_sec": round(done / elapsed, 3) if elapsed
+            else None,
+        }
+        h = reg.get("serve.request_latency_ms")
+        if h is not None and h.count:
+            out["request_latency_ms"] = {
+                "p50": round(h.quantile(0.5), 3),
+                "p95": round(h.quantile(0.95), 3),
+                "p99": round(h.quantile(0.99), 3),
+                "count": h.count,
+            }
+        return out
+
+    # -- ledger records --------------------------------------------------------
+
+    def snapshot_record(self):
+        """The serving state as a ledger-ingestible RunRecord (kind
+        "serve" -> ``serve/<metric>`` series; requests_per_sec is
+        higher-better, latency quantiles lower-better)."""
+        from dmlp_tpu.obs.run import RunRecord, current_device
+        reg = telemetry.registry()
+        eng = self.engine
+        elapsed = (time.monotonic() - self._t_ready) \
+            if self._t_ready else 0.0
+        done = reg.counter("serve.requests_completed").total()
+        metrics: Dict[str, Any] = {
+            "cold_start_compile_ms": eng.cold_start_compile_ms,
+            "compile_count": eng.compile_count,
+            "warm_buckets": len(eng.bucket_stats()["buckets"]),
+            "admitted_total": reg.counter("serve.admitted").total(),
+            "rejected_total": reg.counter("serve.rejected").total(),
+            "batches_total": reg.counter("serve.batches").total(),
+        }
+        if elapsed and done:
+            metrics["requests_per_sec"] = round(done / elapsed, 3)
+            metrics["queries_per_sec"] = round(
+                reg.counter("serve.queries_completed").total() / elapsed,
+                3)
+        h = reg.get("serve.request_latency_ms")
+        if h is not None and h.count:
+            metrics["request_latency_p50_ms"] = round(h.quantile(0.5), 3)
+            metrics["request_latency_p95_ms"] = round(h.quantile(0.95), 3)
+            metrics["request_latency_p99_ms"] = round(h.quantile(0.99), 3)
+            metrics["request_count"] = h.count
+        if eng.last_gated_fraction is not None:
+            metrics["gate_gated_fraction"] = round(
+                eng.last_gated_fraction, 6)
+        return RunRecord(
+            kind="serve", tool="dmlp_tpu.serve",
+            config={"corpus_rows": eng.n_real,
+                    "capacity_rows": eng.capacity_rows,
+                    "num_attrs": eng.num_attrs,
+                    "gate_carry": eng.gate_carry,
+                    "mode": "resident",
+                    "buckets": eng.bucket_stats()["buckets"]},
+            metrics=metrics, device=current_device())
+
+    def _append_record(self) -> None:
+        if self.record_path:
+            try:
+                self.snapshot_record().append_jsonl(self.record_path)
+            except Exception:  # check: no-retry — records never kill
+                pass           # the drain
+
+    # -- run / drain -----------------------------------------------------------
+
+    def run_until_drained(self) -> None:
+        """Block until a drain is requested (SIGTERM or the in-band
+        op), then drain and shut down cleanly."""
+        next_snap = (time.monotonic() + self.snapshot_every_s
+                     if self.snapshot_every_s else None)
+        while not self._drain_event.wait(timeout=0.2):
+            if next_snap is not None and time.monotonic() >= next_snap:
+                self._append_record()
+                next_snap = time.monotonic() + self.snapshot_every_s
+        self.drain()
+
+    def drain(self) -> None:
+        """The orderly shutdown: shed new work, finish queued work,
+        flush records + final telemetry snapshot, close. No flight
+        dump — this is not a crash."""
+        self.admission.draining = True
+        telemetry.registry().gauge("serve.ready").set(0)
+        self._server.shutdown()
+        self.batcher.stop(drain=True)
+        # The batcher completed every queued request; now wait for the
+        # daemonized connection handlers to WRITE those responses — a
+        # drain that exits mid-write loses the response on the floor.
+        self._wait_inflight_drained()
+        self._append_record()
+        if self.session is not None:
+            self.session.set_sigterm_drain(None)
+            self.session.close()     # writes the final snapshot
+        self._server.server_close()
+
+    def close(self) -> None:
+        """Abrupt teardown for tests (no drain semantics)."""
+        self._drain_event.set()
+        self.admission.draining = True
+        self._server.shutdown()
+        self.batcher.stop(drain=False)
+        if self.session is not None:
+            self.session.set_sigterm_drain(None)
+            self.session.close()
+        self._server.server_close()

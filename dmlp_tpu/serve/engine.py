@@ -1,0 +1,619 @@
+"""Resident serving engine: stage once, compile once per shape bucket.
+
+:class:`ResidentEngine` is the serving daemon's solve core. It differs
+from the batch :class:`~dmlp_tpu.engine.single.SingleChipEngine` in
+exactly the three ways a persistent server needs, and nowhere else —
+the candidates -> host-float64 finalize -> boundary-hazard repair
+pipeline (the byte-identity contract with the golden oracle) is
+inherited unchanged:
+
+- **Resident corpus behind a row-count mask.** The corpus is staged to
+  device ONCE at construction, padded to a power-of-two capacity
+  (``tune.cache.shape_bucket``); rows beyond ``n_real`` carry the
+  engines' standard ``id = -1`` sentinel, which every select path
+  already masks to +inf. :meth:`ingest` appends rows by
+  ``dynamic_update_slice`` into the fixed-shape buffer (row counts
+  bucketed so the tiny update program compiles once per bucket) — the
+  SOLVE programs see the same static shapes before and after, so
+  ingestion never recompiles them.
+- **Per-bucket compile-once solves.** Requests bucket to power-of-two
+  (qpad, k) shape buckets. The streaming path is lowered and compiled
+  AHEAD of time per bucket (``jit(...).lower(...).compile()``); the
+  extract path's kernels compile on the bucket's first dispatch
+  (:meth:`warmup` front-loads both before the first request).
+  :attr:`compile_count` counts bucket builds — a replay whose buckets
+  were all warmed must leave it unchanged, the serving layer's
+  no-per-request-recompilation proof.
+- **Cross-request fused-gate warm-up.** The extract path folds the
+  SAME resident chunks for every request, so the MXU gate's
+  effectiveness per chunk is a stable, learnable property. With
+  ``gate_carry`` on, chunks fold in descending historical-winner order
+  ("hot blocks first"): each query's k-th-best thresholds — the gate's
+  input — tighten after the first folds, so later (cold) blocks gate
+  out. The carried state is the per-chunk winner histogram, never a
+  threshold itself: within a request thresholds still only tighten, so
+  the fold is sound in any order, and the boundary-hazard repair makes
+  ties at the candidate boundary exact either way — carry on and off
+  are byte-identical by construction (and proven in the A/B).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.engine.single import (_BF16_AUTO_K_CAP, ChunkThrottle,
+                                    SingleChipEngine, _extract_finalize,
+                                    _topk_blocks, fit_blocks, np_staging_dtype,
+                                    plan_chunks, resolve_kcap, round_up,
+                                    stage_put)
+from dmlp_tpu.io.grammar import KNNInput, Params
+from dmlp_tpu.io.report import QueryResult
+from dmlp_tpu.obs import telemetry
+from dmlp_tpu.obs.trace import span as obs_span
+from dmlp_tpu.ops.topk import TopK
+from dmlp_tpu.resilience import degrade as rs_degrade
+from dmlp_tpu.tune.cache import shape_bucket
+
+
+class CapacityError(RuntimeError):
+    """An ingest would exceed the resident buffer's capacity (the
+    daemon surfaces this as a rejected ingest, never a crash)."""
+
+
+class RequestShapeError(ValueError):
+    """A request shape the resident engine cannot serve (k beyond the
+    serving cap) — admission rejects these before the solve."""
+
+
+def query_bucket(nq: int, granule: int = 8) -> int:
+    """Query-count -> power-of-two jit-cache bucket (>= ``granule``;
+    the extract path's granule is the kernel's QUERY_TILE)."""
+    return max(shape_bucket(max(nq, 1)), granule)
+
+
+def k_bucket(kmax: int) -> int:
+    """Per-request max-k -> power-of-two bucket. Candidate width (and
+    hence the compiled program) derives from the BUCKET, so every k in
+    (bucket/2, bucket] shares one compiled solve."""
+    return shape_bucket(max(kmax, 1))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _update_rows_2d(buf, blk, start):
+    return jax.lax.dynamic_update_slice(
+        buf, blk, (start, jnp.zeros((), jnp.int32)))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _update_rows_1d(buf, blk, start):
+    return jax.lax.dynamic_update_slice(buf, blk, (start,))
+
+
+class _Bucket:
+    """One (qpad, k-bucket) shape bucket: the resolved candidate width,
+    the chosen path, and the AOT-compiled streaming program."""
+
+    __slots__ = ("qpad", "kb", "kcap", "path", "qb", "nqb", "stream")
+
+    def __init__(self, qpad: int, kb: int, kcap: int, path: str,
+                 qb: int, nqb: int):
+        self.qpad, self.kb, self.kcap = qpad, kb, kcap
+        self.path = path          # "extract" | "stream"
+        self.qb, self.nqb = qb, nqb
+        self.stream = None        # AOT-compiled _topk_blocks, when built
+
+    @property
+    def key(self) -> str:
+        return f"q{self.qpad}k{self.kb}"
+
+
+class ResidentEngine(SingleChipEngine):
+    """Compile-once resident engine for the serving daemon.
+
+    ``corpus`` supplies the data side (its query section, if any, is
+    ignored here — the daemon uses it to seed warm-up). ``capacity``
+    is the ingest ceiling in rows (default: the corpus row count's
+    power-of-two bucket, i.e. free headroom to the next boundary).
+    """
+
+    def __init__(self, corpus: KNNInput, config: EngineConfig = None,
+                 capacity: Optional[int] = None, gate_carry: bool = True):
+        super().__init__(config or EngineConfig())
+        cfg = self.config
+        n = corpus.params.num_data
+        na = corpus.params.num_attrs
+        if n < 1:
+            raise ValueError("resident corpus must have at least one row")
+        cap = capacity or shape_bucket(n)
+        if cap < n:
+            raise ValueError(f"capacity {cap} < corpus rows {n}")
+        self.num_attrs = na
+        self.gate_carry = bool(gate_carry)
+
+        # -- plan the streaming layout once, at capacity shape ---------------
+        self._stream_select = cfg.resolve_streaming_select(
+            round_up(cap, 8))
+        granule = cfg.resolve_granule(self._stream_select)
+        self._data_block = fit_blocks(
+            cap, cfg.resolve_data_block(self._stream_select),
+            granule=granule)
+        self.capacity_rows = round_up(cap, self._data_block)
+
+        # -- extract-path eligibility + chunk plan (chunks stage lazily) -----
+        self._extract_ok = (cfg.use_pallas and cfg.resolve_select(
+            round_up(cap, 8)) == "extract")
+        if self._extract_ok:
+            eg = cfg.resolve_granule("extract")
+            _, self._ex_nchunks, self._ex_chunk_rows = plan_chunks(
+                self.capacity_rows, eg, cfg.data_block)
+            self._ex_rows = self._ex_nchunks * self._ex_chunk_rows
+            from dmlp_tpu.ops.pallas_distance import native_pallas_backend
+            self._interpret = not native_pallas_backend()
+        else:
+            self._ex_nchunks = self._ex_chunk_rows = self._ex_rows = 0
+            self._interpret = True
+        self._chunks: Optional[List] = None
+        host_rows = max(self.capacity_rows, self._ex_rows)
+
+        # -- host originals (float64 finalize rescore reads these) -----------
+        self._host_attrs = np.zeros((host_rows, na), np.float64)
+        self._host_attrs[:n] = corpus.data_attrs
+        self._host_labels = np.full(host_rows, -1, np.int32)
+        self._host_labels[:n] = corpus.labels
+        self.n_real = n
+
+        # -- the resident staged corpus (the streaming paths' view) ----------
+        sdt = np_staging_dtype(self._staging)
+        attrs = np.zeros((self.capacity_rows, na), sdt)
+        attrs[:n] = corpus.data_attrs
+        ids = np.full(self.capacity_rows, -1, np.int32)
+        ids[:n] = np.arange(n, dtype=np.int32)
+        with obs_span("serve.stage_resident", rows=self.capacity_rows,
+                      na=na):
+            self._d_attrs = stage_put(attrs, self._staging)
+            self._d_labels = jax.device_put(
+                self._host_labels[:self.capacity_rows])
+            self._d_ids = jax.device_put(ids)
+
+        # -- bucket registry + compile bookkeeping ---------------------------
+        self._buckets: Dict[Tuple[int, int], _Bucket] = {}
+        self._ingest_shapes: set = set()
+        self.compile_count = 0
+        self.cold_start_compile_ms: Optional[float] = None
+        self.bucket_compile_ms: Dict[str, float] = {}
+        # Cross-request gate state: per-chunk winner histogram + last
+        # batch's gated-tile stats (pending device scalar, tile count).
+        self._block_hits = np.zeros(max(self._ex_nchunks, 1), np.int64)
+        self._pending_gate: Optional[Tuple] = None
+        self.last_gated_fraction: Optional[float] = None
+        reg = telemetry.registry()
+        reg.gauge("serve.corpus_rows").set(n)
+        reg.gauge("serve.capacity_rows").set(self.capacity_rows)
+        reg.gauge("serve.gate.carry_enabled").set(int(self.gate_carry))
+
+    # -- shape buckets --------------------------------------------------------
+
+    @property
+    def query_granule(self) -> int:
+        if self._extract_ok:
+            from dmlp_tpu.ops.pallas_extract import QUERY_TILE
+            return QUERY_TILE
+        return 8
+
+    @property
+    def max_k(self) -> int:
+        """Largest per-query k this resident engine serves: the staging
+        dtype's safe cap (bf16 margins blow past the resident layout
+        beyond it) and the corpus capacity."""
+        cap = self.capacity_rows
+        if self._staging == "bfloat16":
+            cap = min(cap, _BF16_AUTO_K_CAP)
+        return cap
+
+    def bucket_shape(self, nq: int, kmax: int) -> Tuple[int, int]:
+        return (query_bucket(nq, self.query_granule), k_bucket(kmax))
+
+    def _kcap_for(self, kb: int) -> int:
+        return resolve_kcap(self.config, kb, self._stream_select,
+                            self.capacity_rows, staging=self._staging)
+
+    def bucket_plan(self, nq: int, kmax: int) -> Tuple[int, int, int]:
+        """(qpad, k-bucket, kcap) for a request/batch shape — the ONE
+        derivation of the candidate width the solve will allocate;
+        admission pricing and the memwatch model read it from here so
+        they cannot drift from what _build_bucket compiles."""
+        qpad, kb = self.bucket_shape(nq, kmax)
+        return qpad, kb, self._kcap_for(kb)
+
+    def _bucket_entry(self, nq: int, kmax: int) -> _Bucket:
+        """The bucket for (nq, kmax), building (and counting) it on
+        first use — warm-up pre-drives this so steady-state serving
+        takes the dict hit only."""
+        if kmax > self.max_k:
+            raise RequestShapeError(
+                f"k={kmax} beyond the serving cap {self.max_k}")
+        key = self.bucket_shape(nq, kmax)
+        entry = self._buckets.get(key)
+        if entry is None:
+            t0 = time.perf_counter()
+            entry = self._build_bucket(*key)
+            self._buckets[key] = entry
+            ms = (time.perf_counter() - t0) * 1e3
+            self.bucket_compile_ms[entry.key] = round(ms, 3)
+            self.compile_count += 1
+            reg = telemetry.registry()
+            reg.counter("serve.bucket_compiles").inc(label=entry.key)
+            reg.histogram("serve.bucket_compile_ms", unit="ms").observe(ms)
+        return entry
+
+    def _build_bucket(self, qpad: int, kb: int) -> _Bucket:
+        cfg = self.config
+        kcap = self._kcap_for(kb)
+        qb = min(1 << max(min(cfg.query_block, qpad).bit_length() - 1, 3),
+                 qpad)
+        nqb = qpad // qb
+        path = "stream"
+        if self._extract_ok and kcap <= 512:
+            from dmlp_tpu.ops import pallas_fused
+            kern, _ = pallas_fused.resolve_topk_kernel(
+                qpad, self._ex_chunk_rows, self.num_attrs, kcap)
+            if kern is not None:
+                path = "extract"
+                self._ensure_chunks()
+        entry = _Bucket(qpad, kb, kcap, path, qb, nqb)
+        if path == "stream":
+            self._compile_stream(entry)
+        return entry
+
+    def _compile_stream(self, entry: _Bucket) -> None:
+        """AOT lower+compile the bucket's streaming program (the
+        cold-start satellite: compilation happens ahead of the first
+        request, not on it)."""
+        cfg = self.config
+        sh = jax.ShapeDtypeStruct
+        na = self.num_attrs
+        entry.stream = _topk_blocks.lower(
+            sh((self.capacity_rows, na), self._dtype),
+            sh((self.capacity_rows,), jnp.int32),
+            sh((self.capacity_rows,), jnp.int32),
+            sh((entry.nqb, entry.qb, na), self._dtype),
+            k=entry.kcap, data_block=self._data_block,
+            select=self._stream_select,
+            use_pallas=cfg.use_pallas).compile()
+
+    # -- resident chunk staging (extract path) --------------------------------
+
+    def _ensure_chunks(self) -> None:
+        if self._chunks is not None or not self._extract_ok:
+            return
+        sdt = np_staging_dtype(self._staging)
+        cr = self._ex_chunk_rows
+        chunks = []
+        with obs_span("serve.stage_chunks", chunks=self._ex_nchunks,
+                      chunk_rows=cr):
+            for c in range(self._ex_nchunks):
+                a = np.zeros((cr, self.num_attrs), sdt)
+                lo = c * cr
+                hi = min(lo + cr, self.n_real)
+                if hi > lo:
+                    a[:hi - lo] = self._host_attrs[lo:hi]
+                chunks.append(stage_put(a, self._staging))
+        self._chunks = chunks
+
+    def _restage_chunk(self, c: int) -> None:
+        sdt = np_staging_dtype(self._staging)
+        cr = self._ex_chunk_rows
+        lo = c * cr
+        hi = min(lo + cr, self.n_real)
+        a = np.zeros((cr, self.num_attrs), sdt)
+        if hi > lo:
+            a[:hi - lo] = self._host_attrs[lo:hi]
+        self._chunks[c] = stage_put(a, self._staging)
+
+    # -- incremental ingestion ------------------------------------------------
+
+    def ingest(self, labels, attrs) -> int:
+        """Append rows to the resident corpus behind the row-count
+        mask; returns the new row count. The solve programs' shapes are
+        untouched (no recompilation); the fixed-shape row update itself
+        compiles once per power-of-two row-count bucket."""
+        labels = np.asarray(labels, np.int32).reshape(-1)
+        attrs = np.asarray(attrs, np.float64)
+        if attrs.ndim != 2 or attrs.shape[1] != self.num_attrs:
+            raise ValueError(
+                f"ingest rows must be (m, {self.num_attrs}), "
+                f"got {attrs.shape}")
+        m = attrs.shape[0]
+        if m != labels.shape[0]:
+            raise ValueError("labels/attrs row-count mismatch")
+        if m == 0:
+            return self.n_real
+        start = self.n_real
+        new_n = start + m
+        if new_n > self.capacity_rows:
+            raise CapacityError(
+                f"ingest of {m} rows exceeds capacity "
+                f"{self.capacity_rows} (resident: {start})")
+        with obs_span("serve.ingest", rows=m, corpus_rows=new_n):
+            self._host_attrs[start:new_n] = attrs
+            self._host_labels[start:new_n] = labels
+            self.n_real = new_n
+            # Bucketed fixed-shape device update, rebuilt from host
+            # state so the pad region rewrites what is already there.
+            mpad = min(shape_bucket(m), self.capacity_rows - start)
+            mpad = max(mpad, m)
+            if (mpad, "u") not in self._ingest_shapes:
+                self._ingest_shapes.add((mpad, "u"))
+                telemetry.registry().counter(
+                    "serve.ingest_compiles").inc(label=str(mpad))
+            sdt = np_staging_dtype(self._staging)
+            blk = np.ascontiguousarray(
+                self._host_attrs[start:start + mpad], sdt)
+            rng = np.arange(start, start + mpad, dtype=np.int32)
+            blk_ids = np.where(rng < new_n, rng, -1).astype(np.int32)
+            blk_labels = self._host_labels[start:start + mpad]
+            s = jax.device_put(np.int32(start))
+            self._d_attrs = _update_rows_2d(
+                self._d_attrs, stage_put(blk, self._staging), s)
+            self._d_labels = _update_rows_1d(
+                self._d_labels, jax.device_put(blk_labels), s)
+            self._d_ids = _update_rows_1d(
+                self._d_ids, jax.device_put(blk_ids), s)
+            if self._chunks is not None:
+                cr = self._ex_chunk_rows
+                for c in range(start // cr, -(-new_n // cr)):
+                    self._restage_chunk(c)
+        reg = telemetry.registry()
+        reg.counter("serve.ingested_rows").inc(m)
+        reg.gauge("serve.corpus_rows").set(new_n)
+        return new_n
+
+    # -- resident solves ------------------------------------------------------
+
+    def _batch_input(self, query_attrs: np.ndarray,
+                     ks: np.ndarray) -> KNNInput:
+        """A micro-batch as a KNNInput over the resident corpus (host
+        views feed the float64 finalize/repair exactly as a solo solve
+        over the same corpus would)."""
+        nq = len(ks)
+        return KNNInput(
+            Params(self.n_real, nq, self.num_attrs),
+            self._host_labels[:self.n_real],
+            self._host_attrs[:self.n_real],
+            np.asarray(ks, np.int32),
+            np.asarray(query_attrs, np.float64))
+
+    def _solve_resident_stream(self, inp: KNNInput,
+                               entry: _Bucket) -> Tuple[TopK, int]:
+        if entry.stream is None:
+            # An extract-path bucket degraded to streaming: build the
+            # fallback program once (counted honestly as a compile).
+            t0 = time.perf_counter()
+            self._compile_stream(entry)
+            self.compile_count += 1
+            telemetry.registry().counter("serve.bucket_compiles").inc(
+                label=entry.key + "_stream_fallback")
+            self.bucket_compile_ms[entry.key + "_stream_fallback"] = \
+                round((time.perf_counter() - t0) * 1e3, 3)
+        nq = inp.params.num_queries
+        na = self.num_attrs
+        q = np.zeros((entry.qpad, na), np.float32)
+        q[:nq] = inp.query_attrs
+        q_blocks = stage_put(q.reshape(entry.nqb, entry.qb, na),
+                             self._staging)
+        self._last_select = self._stream_select
+        with obs_span("serve.solve_stream", qpad=entry.qpad,
+                      kcap=entry.kcap) as sp:
+            out: TopK = entry.stream(self._d_attrs, self._d_labels,
+                                     self._d_ids, q_blocks)
+            sp.fence(out.dists)
+        return TopK(out.dists.reshape(entry.qpad, -1),
+                    out.labels.reshape(entry.qpad, -1),
+                    out.ids.reshape(entry.qpad, -1)), entry.qpad
+
+    def _solve_resident_extract(self, inp: KNNInput, entry: _Bucket
+                                ) -> Optional[Tuple[TopK, int]]:
+        from dmlp_tpu.ops import pallas_fused
+        kern, impl = pallas_fused.resolve_topk_kernel(
+            entry.qpad, self._ex_chunk_rows, self.num_attrs, entry.kcap,
+            rung=self._degrade_rung)
+        if kern is None:
+            return None
+        nq = inp.params.num_queries
+        na = self.num_attrs
+        q = np.zeros((entry.qpad, na), np.float32)
+        q[:nq] = inp.query_attrs
+        q_dev = stage_put(q, self._staging)
+        cr = self._ex_chunk_rows
+        order = self._chunk_order()
+        od = oi = None
+        gz = None
+        ntiles = 0
+        throttle = ChunkThrottle()
+        self._last_select = "extract"
+        self.last_extract_impl = impl
+        with obs_span("serve.solve_extract", qpad=entry.qpad,
+                      kcap=entry.kcap, impl=impl,
+                      carry=self.gate_carry):
+            for c in order:
+                lo = c * cr
+                nr = min(self.n_real - lo, cr)
+                if nr <= 0:
+                    continue
+                od, oi, iters = kern(q_dev, self._chunks[c], od, oi,
+                                     n_real=nr, id_base=lo, kc=entry.kcap,
+                                     interpret=self._interpret)
+                z = jnp.sum(iters == 0)
+                gz = z if gz is None else gz + z
+                ntiles += int(np.prod(iters.shape))
+                throttle.tick(od)
+                telemetry.sample_memory_now()
+        self._pending_gate = (gz, ntiles)
+        top = _extract_finalize(od, oi, self._d_labels, k=entry.kcap)
+        return top, entry.qpad
+
+    def _chunk_order(self) -> List[int]:
+        """Fold order over the resident chunks: hottest (most past
+        winners) first when gate carry-over is on, natural otherwise.
+        Stable sort: cold chunks keep their natural relative order."""
+        idx = range(self._ex_nchunks)
+        if not self.gate_carry:
+            return list(idx)
+        return list(np.argsort(-self._block_hits[:self._ex_nchunks],
+                               kind="stable"))
+
+    # -- SingleChipEngine seam overrides --------------------------------------
+
+    def _solve(self, inp: KNNInput) -> Tuple[TopK, int]:
+        self.last_phase_ms = {}
+        self._pending_iters = []
+        self.last_extract_impl = None
+        if inp.params.num_data != self.n_real:
+            raise ValueError(
+                f"resident solve got a foreign corpus "
+                f"({inp.params.num_data} rows, resident {self.n_real}) — "
+                "build micro-batches with _batch_input/solve_batch")
+        nq = inp.params.num_queries
+        kmax = int(inp.ks.max()) if nq else 1
+        entry = self._bucket_entry(nq, kmax)
+        if entry.path == "extract" and self._degrade_rung != "streaming":
+            out = self._solve_resident_extract(inp, entry)
+            if out is not None:
+                return out
+        return self._solve_resident_stream(inp, entry)
+
+    def _solve_segments(self, inp: KNNInput, allow_multipass: bool = True):
+        # No hetk routing and no multipass on the resident paths: the
+        # serving cap keeps every k single-pass, and one segment per
+        # micro-batch keeps the per-request slicing trivial.
+        self.last_hetk = None
+        self._mp_hazard = None
+        self.last_mp_passes = 0
+        top, qpad = self._solve(inp)
+        return [(top, qpad, None, self._last_select)]
+
+    def run(self, inp: KNNInput) -> List[QueryResult]:
+        # No staging_for_k swap (the parent flips bf16->f32 staging for
+        # wide k, which would mismatch the resident buffers): max_k
+        # already refuses the shapes that swap existed for.
+        kmax = int(inp.ks.max()) if inp.params.num_queries else 0
+        if kmax > self.max_k:
+            raise RequestShapeError(
+                f"k={kmax} beyond the serving cap {self.max_k}")
+        return rs_degrade.run_ladder(self, inp, self._run)
+
+    # -- the serving entry ----------------------------------------------------
+
+    def solve_batch(self, query_attrs, ks) -> List[QueryResult]:
+        """One coalesced micro-batch end to end: pad/bucket, solve on
+        the compiled bucket program, float64-finalize + repair, update
+        the cross-request gate state. Results carry query ids
+        0..nq-1 in batch order — the batcher slices per request."""
+        inp = self._batch_input(np.asarray(query_attrs, np.float64),
+                                np.asarray(ks, np.int32))
+        self._pending_gate = None
+        results = self.run(inp)
+        self._after_batch(results)
+        return results
+
+    def _after_batch(self, results: List[QueryResult]) -> None:
+        if self._pending_gate is not None:
+            gz, ntiles = self._pending_gate
+            self._pending_gate = None
+            try:
+                gated = int(jax.device_get(gz))  # check: allow-host-sync
+                frac = gated / max(ntiles, 1)
+                self.last_gated_fraction = frac
+                reg = telemetry.registry()
+                reg.gauge("serve.gate.gated_fraction").set(round(frac, 6))
+                reg.counter("serve.gate.tiles_total").inc(ntiles)
+                reg.counter("serve.gate.tiles_gated").inc(gated)
+            except Exception:  # check: no-retry — stats never fail a batch
+                pass
+        if self.gate_carry and self._ex_nchunks and results:
+            ids = np.concatenate(
+                [np.asarray(r.neighbor_ids, np.int64) for r in results])
+            ids = ids[ids >= 0]
+            if ids.size:
+                hits = np.bincount(ids // self._ex_chunk_rows,
+                                   minlength=self._ex_nchunks)
+                self._block_hits[:len(hits)] += hits
+
+    # -- warm-up (the cold-start satellite) -----------------------------------
+
+    def warmup(self, buckets) -> Dict[str, float]:
+        """Drive one synthetic micro-batch through every (nq, k) in
+        ``buckets`` BEFORE serving: compiles the bucket programs (AOT
+        for streaming, first-dispatch for the extract kernels) and the
+        shared epilogue jits, and records
+        ``serve.cold_start_compile_ms`` — the startup SLO is a number,
+        not a hope. Returns per-bucket wall ms."""
+        t0 = time.perf_counter()
+        per: Dict[str, float] = {}
+        seen = set()
+        for nq, k in buckets:
+            # Clamp to the serving cap ONLY — k > n_real is a legal
+            # request shape (sentinel padding, golden-identical), so a
+            # requested warm bucket above the corpus row count must
+            # warm THAT k-bucket, not silently a smaller one.
+            k = max(1, min(int(k), self.max_k))
+            nq = max(1, int(nq))
+            key = self.bucket_shape(nq, k)
+            if key in seen:
+                continue
+            seen.add(key)
+            tb = time.perf_counter()
+            idx = np.arange(nq) % self.n_real
+            q = self._host_attrs[:self.n_real][idx]
+            ks = np.full(nq, k, np.int32)
+            with obs_span("serve.warmup_bucket", qpad=key[0], kb=key[1]):
+                self.solve_batch(q, ks)
+            per[f"q{key[0]}k{key[1]}"] = round(
+                (time.perf_counter() - tb) * 1e3, 3)
+        self.cold_start_compile_ms = round(
+            (time.perf_counter() - t0) * 1e3, 3)
+        reg = telemetry.registry()
+        reg.gauge("serve.cold_start_compile_ms").set(
+            self.cold_start_compile_ms)
+        reg.gauge("serve.warm_buckets").set(len(self._buckets))
+        return per
+
+    # -- introspection --------------------------------------------------------
+
+    def bucket_stats(self) -> Dict[str, object]:
+        return {
+            "buckets": sorted(e.key for e in self._buckets.values()),
+            "paths": {e.key: e.path for e in self._buckets.values()},
+            "compile_count": self.compile_count,
+            "bucket_compile_ms": dict(self.bucket_compile_ms),
+            "cold_start_compile_ms": self.cold_start_compile_ms,
+            "corpus_rows": self.n_real,
+            "capacity_rows": self.capacity_rows,
+            "gate_carry": self.gate_carry,
+            "last_gated_fraction": self.last_gated_fraction,
+            "extract_chunks": self._ex_nchunks if self._chunks else 0,
+        }
+
+
+def enable_persistent_compile_cache(directory: str) -> bool:
+    """Best-effort ``jax_compilation_cache_dir`` opt-in (the persistent
+    compilation cache, when this jax build ships it): process restarts
+    then reuse on-disk XLA executables, shrinking the cold-start number
+    the warm-up records. Returns True when enabled."""
+    try:
+        jax.config.update("jax_compilation_cache_dir", directory)
+        try:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+        except Exception:  # check: no-retry — older knob spelling only
+            pass
+        return True
+    except Exception:  # check: no-retry — cache is an optimization only
+        return False

@@ -1,0 +1,145 @@
+"""The daemon's wire protocol: newline-delimited JSON over TCP.
+
+One request object per line, one response object per line, strictly in
+per-connection order (concurrency comes from multiple connections —
+the micro-batcher coalesces across all of them). Shapes:
+
+- ``{"op": "query", "id"?, "k": K | "ks": [...], "queries": [[...]]}``
+  -> ``{"id", "ok": true, "labels": [...], "checksums": [...],
+  "latency_ms"}`` (+ ``"neighbors"``/``"dists"`` with ``"debug": true``).
+  ``checksums`` are the engines' contract FNV-1a values — the replay
+  client reassembles the exact contract stdout (``Query N checksum:
+  C``) and byte-compares it against the golden oracle.
+- ``{"op": "ingest", "labels": [...], "rows": [[...]]}``
+  -> ``{"ok": true, "corpus_rows": N}``; capacity overflow is a clean
+  ``ok: false`` with the reason.
+- ``{"op": "stats"}`` -> engine/admission/registry snapshot.
+- ``{"op": "drain"}`` -> acknowledges and initiates the graceful
+  drain (the in-band SIGTERM).
+
+Rejections and errors are ``{"ok": false, "error": "..."}`` — the
+connection stays usable.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+from dmlp_tpu.serve.batching import Request
+
+#: protocol schema version, echoed in hello/stats
+PROTOCOL_VERSION = 1
+
+#: request-line size cap. The daemon's connection handler enforces it
+#: AT THE READ (``readline(cap + 1)``) so an oversized line never
+#: buffers past the cap; the re-check in parse_request covers
+#: non-socket callers.
+MAX_LINE_BYTES = 64 << 20
+
+
+class ProtocolError(ValueError):
+    """A malformed request line (the response names the defect)."""
+
+
+def _is_int(v) -> bool:
+    """A real JSON integer — bool is an int subclass in Python, and
+    ``{"k": true}`` silently served as k=1 is not the ProtocolError
+    the parser promises for malformed requests."""
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def parse_request(line: str, num_attrs: int) -> Request:
+    """One wire line -> a validated :class:`Request` (op "query" |
+    "ingest") or a control dict for "stats"/"drain". Raises
+    :class:`ProtocolError` with a client-presentable message."""
+    if len(line) > MAX_LINE_BYTES:
+        raise ProtocolError("request line exceeds the size cap")
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError as e:
+        raise ProtocolError(f"bad JSON: {e}") from None
+    if not isinstance(obj, dict):
+        raise ProtocolError("request must be a JSON object")
+    op = obj.get("op", "query")
+    if op in ("stats", "drain"):
+        return obj
+    req_id = str(obj.get("id", ""))
+    if op == "query":
+        queries = obj.get("queries")
+        if not isinstance(queries, list) or not queries:
+            raise ProtocolError("query op needs a non-empty 'queries' "
+                                "list of attribute rows")
+        try:
+            q = np.asarray(queries, np.float64)
+        except (TypeError, ValueError):
+            raise ProtocolError("'queries' rows must be numeric and "
+                                "rectangular") from None
+        if q.ndim != 2 or q.shape[1] != num_attrs:
+            raise ProtocolError(
+                f"'queries' must be (nq, {num_attrs}), got {q.shape}")
+        ks = obj.get("ks")
+        if ks is None:
+            k = obj.get("k")
+            if not _is_int(k) or k < 1:
+                raise ProtocolError("need 'k' (positive int) or 'ks'")
+            ks_arr = np.full(len(q), k, np.int32)
+        else:
+            if (not isinstance(ks, list) or len(ks) != len(q)
+                    or not all(_is_int(v) and v >= 1 for v in ks)):
+                raise ProtocolError("'ks' must list one positive int "
+                                    "per query row")
+            ks_arr = np.asarray(ks, np.int32)
+        return Request(kind="query", req_id=req_id, query_attrs=q,
+                       ks=ks_arr, debug=bool(obj.get("debug")))
+    if op == "ingest":
+        rows = obj.get("rows")
+        labels = obj.get("labels")
+        if not isinstance(rows, list) or not rows:
+            raise ProtocolError("ingest op needs a non-empty 'rows' list")
+        try:
+            attrs = np.asarray(rows, np.float64)
+        except (TypeError, ValueError):
+            raise ProtocolError("'rows' must be numeric and "
+                                "rectangular") from None
+        if attrs.ndim != 2 or attrs.shape[1] != num_attrs:
+            raise ProtocolError(
+                f"'rows' must be (m, {num_attrs}), got {attrs.shape}")
+        if (not isinstance(labels, list) or len(labels) != len(rows)
+                or not all(_is_int(v) for v in labels)):
+            raise ProtocolError("'labels' must list one int per row")
+        return Request(kind="ingest", req_id=req_id,
+                       labels=np.asarray(labels, np.int32), attrs=attrs)
+    raise ProtocolError(f"unknown op {op!r}")
+
+
+def query_response(req: Request, debug: bool = False) -> Dict[str, Any]:
+    """The completed query Request -> its wire response."""
+    if req.error is not None:
+        return {"id": req.req_id, "ok": False, "error": req.error}
+    out: Dict[str, Any] = {
+        "id": req.req_id, "ok": True,
+        "labels": [int(r.predicted_label) for r in req.results],
+        "checksums": [int(r.checksum()) for r in req.results],
+        "latency_ms": round(req.latency_ms, 3),
+    }
+    if debug or req.debug:
+        out["neighbors"] = [[int(i) for i in r.neighbor_ids]
+                            for r in req.results]
+        out["dists"] = [[float(d) for d in r.neighbor_dists]
+                        for r in req.results]
+    return out
+
+
+def ingest_response(req: Request) -> Dict[str, Any]:
+    if req.error is not None:
+        return {"id": req.req_id, "ok": False, "error": req.error}
+    return {"id": req.req_id, "ok": True,
+            "corpus_rows": int(req.corpus_rows)}
+
+
+def encode(obj: Dict[str, Any]) -> bytes:
+    return (json.dumps(obj, separators=(",", ":"),
+                       sort_keys=True) + "\n").encode()

@@ -1,0 +1,538 @@
+"""Online serving layer (dmlp_tpu.serve): padding parity, compile-once,
+ingestion, gate carry-over, admission control, batching, daemon e2e.
+
+The load-bearing contract: every bucketed/padded micro-batch response
+must be BYTE-IDENTICAL to the solo unpadded solve over the same corpus
+and to the float64 golden oracle — fuzzed across power-of-two bucket
+boundaries (nq and k straddling 8/16/32), with gate carry-over on and
+off, before and after incremental ingestion.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.engine.single import SingleChipEngine
+from dmlp_tpu.golden.reference import knn_golden
+from dmlp_tpu.io.grammar import KNNInput, Params
+from dmlp_tpu.io.report import format_results
+from dmlp_tpu.obs import telemetry
+from dmlp_tpu.serve import client as sc
+from dmlp_tpu.serve import protocol
+from dmlp_tpu.serve.admission import AdmissionController
+from dmlp_tpu.serve.batching import MicroBatcher, Request
+from dmlp_tpu.serve.daemon import ServeDaemon
+from dmlp_tpu.serve.engine import (CapacityError, RequestShapeError,
+                                   ResidentEngine, k_bucket, query_bucket)
+
+
+def make_corpus(n=600, na=5, labels=4, seed=3) -> KNNInput:
+    rng = np.random.default_rng(seed)
+    return KNNInput(Params(n, 0, na),
+                    rng.integers(0, labels, n).astype(np.int32),
+                    rng.uniform(-10, 10, (n, na)),
+                    np.zeros(0, np.int32), np.zeros((0, na)))
+
+
+def solo_and_golden(corpus: KNNInput, q, ks, config=None):
+    inp = KNNInput(Params(corpus.params.num_data, len(ks),
+                          corpus.params.num_attrs),
+                   corpus.labels, corpus.data_attrs,
+                   np.asarray(ks, np.int32), np.asarray(q, np.float64))
+    solo = format_results(
+        SingleChipEngine(config or EngineConfig()).run(inp))
+    gold = format_results(knn_golden(inp))
+    assert solo == gold
+    return solo
+
+
+# -- buckets ------------------------------------------------------------------
+
+def test_shape_buckets_are_powers_of_two():
+    assert [query_bucket(v) for v in (1, 7, 8, 9, 17)] == \
+        [8, 8, 8, 16, 32]
+    assert query_bucket(3, granule=128) == 128
+    assert [k_bucket(v) for v in (1, 2, 3, 8, 9, 17)] == \
+        [1, 2, 4, 8, 16, 32]
+
+
+# -- padding parity (the tentpole's byte-identity contract) -------------------
+
+def test_padding_parity_fuzz_across_bucket_boundaries():
+    """nq and k straddling powers of two: every served batch equals the
+    solo solve and the golden oracle byte-for-byte."""
+    corpus = make_corpus()
+    eng = ResidentEngine(corpus, EngineConfig())
+    rng = np.random.default_rng(21)
+    for nq in (1, 7, 8, 9, 15, 16, 17):
+        for kmax in (1, 7, 8, 9, 16, 17):
+            q = rng.uniform(-10, 10, (nq, corpus.params.num_attrs))
+            ks = rng.integers(1, kmax + 1, nq).astype(np.int32)
+            got = format_results(eng.solve_batch(q, ks))
+            assert got == solo_and_golden(corpus, q, ks), \
+                f"parity broke at nq={nq} kmax={kmax}"
+
+
+def test_compile_once_per_bucket_and_no_request_recompilation():
+    corpus = make_corpus()
+    eng = ResidentEngine(corpus, EngineConfig())
+    eng.warmup([(8, 8), (16, 8), (8, 16)])
+    c0 = eng.compile_count
+    rng = np.random.default_rng(5)
+    for nq, k in [(3, 5), (8, 8), (12, 8), (5, 16), (8, 13)]:
+        eng.solve_batch(rng.uniform(-10, 10, (nq, 5)),
+                        np.full(nq, k, np.int32))
+    assert eng.compile_count == c0, \
+        "a warmed-bucket request recompiled"
+    # a genuinely new bucket compiles exactly once
+    eng.solve_batch(rng.uniform(-10, 10, (40, 5)),
+                    np.full(40, 4, np.int32))
+    assert eng.compile_count == c0 + 1
+    eng.solve_batch(rng.uniform(-10, 10, (33, 5)),
+                    np.full(33, 3, np.int32))  # same (q64, k4) bucket
+    assert eng.compile_count == c0 + 1
+
+
+def test_warmup_records_cold_start_and_dedups_buckets():
+    eng = ResidentEngine(make_corpus(), EngineConfig())
+    per = eng.warmup([(8, 8), (7, 7), (3, 5)])   # all one (q8, k8) bucket
+    assert len(per) == 1 and eng.compile_count == 1
+    assert eng.cold_start_compile_ms is not None \
+        and eng.cold_start_compile_ms > 0
+    assert eng.bucket_stats()["cold_start_compile_ms"] == \
+        eng.cold_start_compile_ms
+
+
+# -- incremental ingestion ----------------------------------------------------
+
+def test_ingest_parity_and_no_solve_recompilation():
+    corpus = make_corpus(n=500)
+    eng = ResidentEngine(corpus, EngineConfig(), capacity=1024)
+    rng = np.random.default_rng(9)
+    q = rng.uniform(-10, 10, (6, 5))
+    ks = np.full(6, 9, np.int32)
+    eng.solve_batch(q, ks)
+    c0 = eng.compile_count
+    labels_all = corpus.labels
+    attrs_all = corpus.data_attrs
+    for m in (1, 7, 64):                        # straddle update buckets
+        newl = rng.integers(0, 4, m).astype(np.int32)
+        newa = rng.uniform(-10, 10, (m, 5))
+        eng.ingest(newl, newa)
+        labels_all = np.concatenate([labels_all, newl])
+        attrs_all = np.vstack([attrs_all, newa])
+        grown = KNNInput(Params(len(labels_all), 0, 5), labels_all,
+                         attrs_all, np.zeros(0, np.int32),
+                         np.zeros((0, 5)))
+        got = format_results(eng.solve_batch(q, ks))
+        assert got == solo_and_golden(grown, q, ks), \
+            f"ingest parity broke at +{m} rows"
+    assert eng.compile_count == c0, "ingestion recompiled a solve"
+    assert eng.n_real == 500 + 1 + 7 + 64
+
+
+def test_ingest_capacity_error():
+    eng = ResidentEngine(make_corpus(n=500), EngineConfig(),
+                         capacity=512)
+    with pytest.raises(CapacityError):
+        eng.ingest(np.zeros(600, np.int32), np.zeros((600, 5)))
+    # a failed ingest changes nothing
+    assert eng.n_real == 500
+
+
+def test_request_shape_cap():
+    eng = ResidentEngine(make_corpus(n=100), EngineConfig(),
+                         capacity=128)
+    with pytest.raises(RequestShapeError):
+        eng.solve_batch(np.zeros((2, 5)), np.full(2, 500, np.int32))
+
+
+def test_k_beyond_corpus_rows_pads_with_sentinels_like_golden():
+    """k in (n_real, capacity] is LEGAL: the reference contract pads
+    with id = -1 sentinels when fewer than k candidates exist
+    (common.cpp:66), and the golden oracle does the same — a served
+    response must match it byte-for-byte, not get rejected."""
+    corpus = make_corpus(n=100)
+    eng = ResidentEngine(corpus, EngineConfig(), capacity=128)
+    rng = np.random.default_rng(8)
+    q = rng.uniform(-10, 10, (3, 5))
+    ks = np.array([120, 100, 101], np.int32)
+    got = eng.solve_batch(q, ks)
+    assert got[0].neighbor_ids[-1] == -1          # sentinel tail
+    assert format_results(got) == solo_and_golden(corpus, q, ks)
+
+
+# -- extract path + cross-request gate warm-up --------------------------------
+
+def extract_config():
+    return EngineConfig(select="extract", use_pallas=True,
+                        data_block=12800)
+
+
+def test_extract_gate_carry_ab_byte_identical_and_golden():
+    """Carry on vs off over multiple batches on the resident extract
+    path: identical bytes, both equal to the golden oracle."""
+    corpus = make_corpus(n=20000, na=4, seed=31)
+    outs = {}
+    for carry in (True, False):
+        eng = ResidentEngine(corpus, extract_config(), gate_carry=carry)
+        texts = []
+        for i in range(3):
+            rng = np.random.default_rng(400 + i)
+            q = rng.uniform(-10, 10, (9, 4))
+            ks = rng.integers(1, 9, 9).astype(np.int32)
+            texts.append(format_results(eng.solve_batch(q, ks)))
+            assert eng.last_extract_impl in ("fused", "extract")
+        outs[carry] = texts
+    assert outs[True] == outs[False]
+    rng = np.random.default_rng(402)
+    q = rng.uniform(-10, 10, (9, 4))
+    ks = rng.integers(1, 9, 9).astype(np.int32)
+    inp = KNNInput(Params(20000, 9, 4), corpus.labels,
+                   corpus.data_attrs, ks, q)
+    assert outs[True][2] == format_results(knn_golden(inp))
+
+
+def test_gate_carry_hot_block_ordering_gates_cold_blocks():
+    """Non-vacuous warm-up proof on a norm-banded corpus: the winners
+    live in the LAST chunk, so natural order folds them last (cold
+    blocks never gate — they fold before any tight threshold exists),
+    while carry-over folds the hot chunk first and the far bands gate
+    out. Results stay byte-identical either way."""
+    rng = np.random.default_rng(55)
+    n, na = 38400, 4                       # 3 extract chunks of 12800
+    base = rng.uniform(-1.0, 1.0, (n, na))
+    attrs = base.copy()
+    attrs[:12800] += 600.0                 # far band (never wins)
+    attrs[12800:25600] += 300.0            # middle band (never wins)
+    corpus = KNNInput(Params(n, 0, na),
+                      rng.integers(0, 4, n).astype(np.int32), attrs,
+                      np.zeros(0, np.int32), np.zeros((0, na)))
+    q = rng.uniform(-1.0, 1.0, (8, na))    # near the 3rd band
+    ks = np.full(8, 5, np.int32)
+    fracs, texts = {}, {}
+    for carry in (True, False):
+        eng = ResidentEngine(corpus, extract_config(), gate_carry=carry)
+        t = [format_results(eng.solve_batch(q + 0.01 * i, ks))
+             for i in range(2)]
+        texts[carry] = t[0]
+        fracs[carry] = eng.last_gated_fraction
+    assert texts[True] == texts[False]
+    # First batch teaches the histogram; the second folds the hot
+    # (winning) chunk first, so both far bands gate out entirely.
+    assert fracs[True] is not None and fracs[True] > 0.5
+    assert fracs[True] > (fracs[False] or 0.0)
+
+
+def test_extract_ingest_into_new_chunk_stays_golden():
+    corpus = make_corpus(n=12800, na=4, seed=77)
+    eng = ResidentEngine(corpus, extract_config(), capacity=25600)
+    rng = np.random.default_rng(6)
+    q = rng.uniform(-10, 10, (5, 4))
+    ks = np.full(5, 6, np.int32)
+    eng.solve_batch(q, ks)
+    m = 200                                 # spills into chunk 2
+    newl = rng.integers(0, 4, m).astype(np.int32)
+    newa = rng.uniform(-10, 10, (m, 4))
+    eng.ingest(newl, newa)
+    grown = KNNInput(Params(12800 + m, 0, 4),
+                     np.concatenate([corpus.labels, newl]),
+                     np.vstack([corpus.data_attrs, newa]),
+                     np.zeros(0, np.int32), np.zeros((0, 4)))
+    got = format_results(eng.solve_batch(q, ks))
+    assert got == solo_and_golden(grown, q, ks, extract_config())
+
+
+# -- admission control --------------------------------------------------------
+
+def test_admission_memory_budget_sheds_before_solve():
+    eng = ResidentEngine(make_corpus(), EngineConfig())
+    adm = AdmissionController(eng, budget_bytes=1)   # everything over
+    d = adm.decide(4, 4, queued_queries=0)
+    assert d["verdict"] == "reject" and d["reason"] == "memory"
+    adm2 = AdmissionController(eng, budget_bytes=1 << 40)
+    assert adm2.decide(4, 4, 0)["verdict"] == "accept"
+    assert adm2.headroom_bytes() < (1 << 40)   # model priced in
+
+
+def test_admission_prices_the_coalesced_batch_not_the_lone_request():
+    """64 small admits must not OOM as one coalesced micro-batch: the
+    memory check prices min(queued + nq, batch cap) at the queue's
+    running kmax, so the budget that admits a lone request refuses the
+    same request once the queue it would join is deep."""
+    eng = ResidentEngine(make_corpus(), EngineConfig())
+    lone = AdmissionController(eng, batch_queries_cap=512)
+    lone_need = lone.batch_bytes(8, 4)
+    coalesced_need = lone.batch_bytes(512, 4)
+    assert coalesced_need > lone_need
+    budget = lone._resident_model_bytes() + lone_need + 1
+    adm = AdmissionController(eng, budget_bytes=budget,
+                              batch_queries_cap=512)
+    assert adm.decide(8, 4, queued_queries=0)["verdict"] == "accept"
+    d = adm.decide(8, 4, queued_queries=504, queued_kmax=4)
+    assert d["verdict"] == "reject" and d["reason"] == "memory"
+
+
+def test_warmup_honors_k_above_corpus_rows():
+    """An explicit warm bucket with n_real < k <= capacity must warm
+    THAT k-bucket (k > n_real is a served shape), so the first real
+    wide-k request finds it compiled."""
+    eng = ResidentEngine(make_corpus(n=100), EngineConfig(),
+                         capacity=1024)
+    eng.warmup([(4, 512)])
+    c0 = eng.compile_count
+    rng = np.random.default_rng(3)
+    eng.solve_batch(rng.uniform(-10, 10, (4, 5)),
+                    np.full(4, 400, np.int32))   # same (q8, k512) bucket
+    assert eng.compile_count == c0, \
+        "warm-up silently warmed a smaller k-bucket"
+
+
+def test_admission_rejects_shapes_queue_and_draining():
+    eng = ResidentEngine(make_corpus(), EngineConfig())
+    adm = AdmissionController(eng, max_queue_queries=10,
+                              max_request_queries=8, max_k=16)
+    assert adm.decide(9, 4, 0)["reason"] == "shape"
+    assert adm.decide(2, 17, 0)["reason"] == "k_too_large"
+    assert adm.decide(4, 4, 8)["reason"] == "queue_full"
+    adm.draining = True
+    assert adm.decide(1, 1, 0)["reason"] == "draining"
+
+
+def test_admission_injected_squeeze_sheds_without_ladder(monkeypatch):
+    from dmlp_tpu.resilience import inject as rs_inject
+    from dmlp_tpu.resilience import stats as rs_stats
+    rs_stats.reset()
+    eng = ResidentEngine(make_corpus(), EngineConfig())
+    adm = AdmissionController(eng)
+    sched = rs_inject.FaultSchedule.from_dict(
+        {"schema": 1, "seed": 0, "faults": [
+            {"site": "serve.admit", "kind": "oom", "times": 1}]})
+    rs_inject.install(sched)
+    try:
+        d = adm.decide(2, 2, 0)
+        assert d["verdict"] == "reject" \
+            and d["reason"] == "injected_squeeze"
+        assert adm.decide(2, 2, 0)["verdict"] == "accept"  # once only
+    finally:
+        rs_inject.uninstall()
+    assert rs_stats.snapshot().get("degradations") == []
+    assert telemetry.registry().counter("serve.rejected").value(
+        label="injected_squeeze") >= 1
+
+
+# -- micro-batching -----------------------------------------------------------
+
+def test_batcher_coalesces_and_slices_per_request():
+    corpus = make_corpus()
+    eng = ResidentEngine(corpus, EngineConfig())
+    adm = AdmissionController(eng)
+    b = MicroBatcher(eng, adm, max_batch_queries=64, tick_s=0.02)
+    rng = np.random.default_rng(13)
+    reqs = []
+    for i in range(5):
+        nq = int(rng.integers(1, 7))
+        reqs.append(Request(
+            kind="query", req_id=str(i),
+            query_attrs=rng.uniform(-10, 10, (nq, 5)),
+            ks=rng.integers(1, 9, nq).astype(np.int32)))
+    b.start()
+    try:
+        for r in reqs:
+            assert b.submit(r)["verdict"] == "accept"
+        for r in reqs:
+            assert r.done.wait(timeout=120)
+    finally:
+        b.stop(drain=True)
+    assert b.batches < len(reqs), "nothing coalesced"
+    for r in reqs:
+        assert r.error is None
+        got = format_results(r.results)
+        assert got == solo_and_golden(corpus, r.query_attrs, r.ks), \
+            f"sliced-out request {r.req_id} differs from solo solve"
+
+
+def test_batcher_drain_finishes_queued_work():
+    eng = ResidentEngine(make_corpus(), EngineConfig())
+    b = MicroBatcher(eng, AdmissionController(eng), tick_s=0.0)
+    rng = np.random.default_rng(2)
+    reqs = [Request(kind="query", req_id=str(i),
+                    query_attrs=rng.uniform(-10, 10, (2, 5)),
+                    ks=np.full(2, 3, np.int32)) for i in range(4)]
+    for r in reqs:
+        b.submit(r)
+    b.start()
+    b.stop(drain=True)
+    assert all(r.done.is_set() and r.error is None for r in reqs)
+
+
+# -- protocol -----------------------------------------------------------------
+
+def test_protocol_parse_and_errors():
+    req = protocol.parse_request(
+        json.dumps({"op": "query", "id": "a", "k": 3,
+                    "queries": [[1, 2], [3, 4]]}), 2)
+    assert req.kind == "query" and req.nq == 2 \
+        and list(req.ks) == [3, 3]
+    ctl = protocol.parse_request('{"op": "stats"}', 2)
+    assert isinstance(ctl, dict)
+    for bad in ('{"op": "query"}',
+                '{"op": "query", "queries": [[1]]}',        # na mismatch
+                '{"op": "query", "k": 0, "queries": [[1, 2]]}',
+                '{"op": "query", "ks": [1], "queries": [[1, 2], [3, 4]]}',
+                '{"op": "ingest", "rows": [[1, 2]]}',
+                'not json', '[1]', '{"op": "wat"}'):
+        with pytest.raises(protocol.ProtocolError):
+            protocol.parse_request(bad, 2)
+
+
+# -- daemon end to end (in-process, real sockets) -----------------------------
+
+def test_daemon_end_to_end_replay_ingest_stats_drain():
+    corpus = make_corpus(n=800, seed=41)
+    d = ServeDaemon(corpus, EngineConfig(), port=0,
+                    warm_buckets=[(8, 8), (16, 8)])
+    d.start()
+    try:
+        header = {"serve_trace_schema": 1,
+                  "corpus": {"num_attrs": 5, "min_attr": -10,
+                             "max_attr": 10}}
+        reqs = [{"nq": 1 + (i % 4), "k": 1 + (i % 6), "seed": 800 + i}
+                for i in range(8)]
+        res = sc.replay(d.port, header, reqs, connections=3)
+        assert all(r["ok"] for r in res)
+        golden = sc.golden_reference(corpus, header, reqs)
+        assert sc.contract_text([r["checksums"] for r in res]) == \
+            sc.contract_text(golden)
+        cli = sc.ServeClient(d.port)
+        st = cli.stats()["stats"]
+        assert st["requests_completed"] >= 8
+        assert st["engine"]["compile_count"] == d.engine.compile_count
+        # wire ingestion + grown-corpus parity
+        rng = np.random.default_rng(1)
+        newa = rng.uniform(-10, 10, (3, 5))
+        r = cli.ingest([0, 1, 2], newa)
+        assert r["ok"] and r["corpus_rows"] == 803
+        grown = KNNInput(
+            Params(803, 0, 5),
+            np.concatenate([corpus.labels,
+                            np.array([0, 1, 2], np.int32)]),
+            np.vstack([corpus.data_attrs, newa]),
+            np.zeros(0, np.int32), np.zeros((0, 5)))
+        res2 = sc.replay(d.port, header, reqs[:3], connections=2)
+        assert [r["checksums"] for r in res2] == \
+            sc.golden_reference(grown, header, reqs[:3])
+        # malformed line leaves the connection usable
+        bad = cli.call({"op": "query"})
+        assert not bad["ok"] and "queries" in bad["error"]
+        assert cli.stats()["ok"]
+        # in-band drain: a request already queued when the drain
+        # lands must still get its response before shutdown
+        # (the drain waits for handler threads to write).
+        late = sc.ServeClient(d.port)
+        assert cli.drain()["draining"]
+        cli.close()
+        t = threading.Thread(target=d.run_until_drained, daemon=True)
+        t.start()
+        t.join(timeout=60)
+        assert not t.is_alive(), "drain hung"
+        assert d._inflight == 0
+        late.close()
+    finally:
+        if not d._drain_event.is_set():
+            d.close()
+
+
+def test_daemon_rejections_surface_as_protocol_errors():
+    corpus = make_corpus(n=300)
+    d = ServeDaemon(corpus, EngineConfig(), port=0, max_k=4,
+                    warm_buckets=[(1, 1)])
+    d.start()
+    try:
+        cli = sc.ServeClient(d.port)
+        r = cli.query(np.zeros((1, 5)), k=99)
+        assert not r["ok"] and "k_too_large" in r["error"]
+        r = cli.query(np.zeros((1, 5)), k=2)
+        assert r["ok"]
+        cli.close()
+    finally:
+        d.close()
+
+
+def test_daemon_serve_record_round_trips_ledger(tmp_path):
+    rec = tmp_path / "SERVE_TEST_r99.jsonl"
+    corpus = make_corpus(n=300)
+    d = ServeDaemon(corpus, EngineConfig(), port=0,
+                    record_path=str(rec), warm_buckets=[(1, 1)])
+    d.start()
+    try:
+        cli = sc.ServeClient(d.port)
+        assert cli.query(np.zeros((2, 5)), k=3)["ok"]
+        cli.close()
+    finally:
+        d.drain()
+    from dmlp_tpu.obs.ledger import ingest_file
+    entry = ingest_file(str(rec))
+    assert entry["status"] == "parsed"
+    series = {p["series"] for p in entry["points"]}
+    assert "serve/cold_start_compile_ms" in series
+    assert "serve/requests_per_sec" in series
+    assert any(p["round"] == 99 for p in entry["points"])
+
+
+# -- telemetry drain hook (the PR 9 SIGTERM clean-drain satellite) ------------
+
+def test_sigterm_drain_hook_skips_flight_dump(tmp_path):
+    sess = telemetry.start(path=str(tmp_path / "t.prom"),
+                           handle_signals=False)
+    try:
+        fired = []
+        sess.set_sigterm_drain(lambda: fired.append(1))
+        sess._on_sigterm(15, None)
+        assert fired == [1]
+        assert not sess.flight.dumped, \
+            "drain-hook SIGTERM must not dump a flight artifact"
+        events = [e["name"] for e in sess.flight.events()]
+        assert "sigterm_drain" in events
+    finally:
+        sess.set_sigterm_drain(None)
+        sess.close()
+
+
+# -- serve metric names pass the R6 static contract ---------------------------
+
+def test_serve_metric_names_pass_r6():
+    import os
+
+    from dmlp_tpu.check.analyzer import analyze_paths
+    pkg = os.path.join(os.path.dirname(__file__), "..", "dmlp_tpu",
+                       "serve")
+    findings = [f for f in analyze_paths([os.path.abspath(pkg)])
+                if f.rule.startswith("R6")]
+    assert findings == [], [str(f) for f in findings]
+
+
+# -- memwatch serve model -----------------------------------------------------
+
+def test_serve_memwatch_model_terms_hand_computed():
+    from dmlp_tpu.obs import memwatch
+    m = memwatch.resident_bytes_model(
+        "serve", capacity_rows=1024, na=8, staging="float32",
+        qpad=16, kcap=24, extract_chunks=2, chunk_rows=512)
+    t = m["terms"]
+    assert t["resident_corpus"] == 1024 * 8 * 4
+    assert t["labels_ids"] == 1024 * 8
+    assert t["extract_chunks"] == 2 * 512 * 8 * 4
+    assert t["query_blocks"] == 16 * 8 * 4
+    assert t["topk_carries"] == 2 * 16 * 24 * 12
+    assert m["total_bytes"] == sum(t.values())
+    eng = ResidentEngine(make_corpus(), EngineConfig())
+    live = memwatch.model_for_engine(
+        eng, eng._batch_input(np.zeros((4, 5)), np.full(4, 3, np.int32)))
+    assert live["kind"] == "serve" \
+        and live["terms"]["resident_corpus"] > 0

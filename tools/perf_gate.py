@@ -43,7 +43,7 @@ from dmlp_tpu.obs.ledger import build_ledger, series_deltas  # noqa: E402
 #: migrated RunRecord emitters (obs.ledger._runrecord_series_name), so
 #: the r05->r06 transition keeps its round-over-round comparison; the
 #: "{kind}:" prefixes catch RunRecord series with no legacy ancestor.
-GATED_PREFIXES = ("harness/", "bench:", "bench/", "trainbench/",
+GATED_PREFIXES = ("harness/", "bench:", "bench/", "trainbench/", "serve/",
                   "train:", "engine:", "roofline:", "capacity:",
                   "telemetry/")
 
