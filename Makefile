@@ -7,7 +7,7 @@ CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
 .PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
 	perf-gate check lint chaos-smoke telemetry-smoke serve-smoke \
-	serve-bench clean
+	race-smoke serve-bench clean
 
 all: native
 
@@ -17,14 +17,17 @@ native/_fastparse.so: native/fastparse.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
 test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint \
-	chaos-smoke telemetry-smoke serve-smoke
+	chaos-smoke telemetry-smoke serve-smoke race-smoke
 	python -m pytest tests/ -q
 
 # Static analysis + runtime-sanitizer smoke (README "Static analysis &
-# sanitizers"): the AST rule families R1-R4 (collective-axis contract,
-# recompilation hazards, host-sync hazards, compat-bypass) over the whole
+# sanitizers"): the AST rule families R1-R7 (collective-axis contract,
+# recompilation hazards, host-sync hazards, compat-bypass, resilience
+# swallowing, metric names, concurrency discipline) over the whole
 # package, gated by check_baseline.json — the committed baseline is EMPTY,
-# so ANY finding fails. Then the runtime half: bench config 1 through the
+# so ANY finding fails. Results are cached per file content hash under
+# ~/.cache/dmlp_tpu/check, so re-runs only re-analyze changed files
+# (--no-cache opts out). Then the runtime half: bench config 1 through the
 # real CLI under DMLP_TPU_SANITIZE=1 (jax.transfer_guard("disallow") +
 # jax.checking_leaks active around the solve) must complete with contract
 # stdout byte-identical to the plain run — the hot path is transfer-clean
@@ -179,6 +182,22 @@ serve-smoke:
 	rm -f outputs/serve/SERVE_SMOKE.jsonl
 	JAX_PLATFORMS=cpu python tools/serve_smoke.py --out outputs/serve \
 	  --record outputs/serve/SERVE_SMOKE.jsonl
+
+# Concurrency-discipline smoke (README "Static analysis & sanitizers",
+# rule family R7): the lock-order / guarded-field / blocking-under-lock
+# / thread-lifecycle analyzer must be clean over the whole package with
+# no baseline, then tools/race_stress.py proves the runtime half — the
+# race sanitizer first catches a SEEDED inversion and sleep-under-lock
+# (teeth), then the live daemon is hammered by concurrent query +
+# ingest + stats + scrape workers with the Sampler and fault injection
+# running: every stressed response must be byte-identical to the golden
+# oracle and the sanitizer's verdict over the real system must be
+# empty (zero inversions, zero blocking calls under a lock).
+race-smoke:
+	mkdir -p outputs/race
+	JAX_PLATFORMS=cpu python -m dmlp_tpu.check --families R7 \
+	  --no-baseline
+	JAX_PLATFORMS=cpu python tools/race_stress.py --out outputs/race
 
 # Serving throughput bench (not in `make test`; emits the SERVE_rNN
 # ledger rounds): replay inputs/serve_trace1.jsonl against the daemon

@@ -31,17 +31,34 @@ enforcing repo-specific rule families:
   APIs (``shard_map`` spellings, ``axis_size``, Pallas
   ``CompilerParams``, host memory-kind strings) anywhere outside
   ``utils/compat.py``.
+- **R5 resilience swallowing** (:mod:`.resilient`): broad ``except
+  Exception`` without re-raise or ``# check: no-retry`` in the
+  resilience/serving error paths.
+- **R6 metric-name contract** (:mod:`.metricnames`): literal
+  snake_case registry names, one kind per name package-wide.
+- **R7 concurrency discipline** (:mod:`.concurrency`): lock-order
+  inversions over the inferred package lock graph, guarded-field
+  accesses outside their lock (incl. mutable reference escapes),
+  blocking calls under a lock, and thread-lifecycle holes — the
+  threaded serving/telemetry surface's contracts, machine-enforced.
 - **R0 hygiene** (:mod:`.hygiene`): the conservative ruff subset
   (unused imports, bare except, mutable default args, pointless
   f-strings) so ``make lint`` has teeth even on containers without
   ruff installed (the pyproject ``[tool.ruff]`` config mirrors it).
 
-Accepted pre-existing findings are pinned in ``check_baseline.json``
-(:mod:`.baseline`); any NEW finding fails ``make check``. The runtime
-side lives in :mod:`.sanitize`: ``DMLP_TPU_SANITIZE=1`` / ``--sanitize``
-wraps solves in ``jax.transfer_guard("disallow")`` +
-``jax.checking_leaks()`` (plus ``debug_nans`` for training) so the hot
-path is provably free of implicit host syncs at runtime too.
+Cross-module context flows through the cacheable facts layer
+(:mod:`.facts`), and verdicts are cached per file content hash
+(:mod:`.cache`) so re-runs only re-analyze changed files;
+``--stale-allows`` reports allow-directives that no longer suppress
+anything. Accepted pre-existing findings are pinned in
+``check_baseline.json`` (:mod:`.baseline`); any NEW finding fails
+``make check``. The runtime side lives in :mod:`.sanitize`
+(``DMLP_TPU_SANITIZE=1`` / ``--sanitize`` wraps solves in
+``jax.transfer_guard("disallow")`` + ``jax.checking_leaks()`` — the
+hot path is provably free of implicit host syncs at runtime too) and
+:mod:`.racecheck` (``DMLP_TPU_RACECHECK=1``: instrumented lock
+factories record real acquisition orders, catching actual inversions
+and blocking-under-lock as they happen — ``make race-smoke``).
 """
 
 from dmlp_tpu.check.analyzer import analyze_package, analyze_paths

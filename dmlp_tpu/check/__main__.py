@@ -7,11 +7,17 @@ the verdict.
 
 Usage::
 
-    python -m dmlp_tpu.check                      # R1-R4 over the package
+    python -m dmlp_tpu.check                      # R1-R7 over the package
     python -m dmlp_tpu.check --families R0        # hygiene only (make lint)
     python -m dmlp_tpu.check --json               # machine output
     python -m dmlp_tpu.check --write-baseline     # accept current findings
+    python -m dmlp_tpu.check --stale-allows       # dead allow-directives
+    python -m dmlp_tpu.check --no-cache ...       # bypass ~/.cache
     python -m dmlp_tpu.check path/to/file.py ...  # explicit targets
+
+Analysis results are cached per file content hash under
+``~/.cache/dmlp_tpu/check`` ($DMLP_TPU_CHECK_CACHE overrides) so
+re-runs only re-analyze changed files; ``--no-cache`` opts out.
 """
 
 from __future__ import annotations
@@ -22,10 +28,13 @@ import sys
 from typing import Optional, Sequence
 
 from dmlp_tpu.check.analyzer import (ALL_FAMILIES, DEFAULT_FAMILIES,
-                                     analyze_paths, package_root,
-                                     repo_root)
+                                     analyze_paths,
+                                     analyze_paths_tracking,
+                                     package_root, repo_root,
+                                     stale_allow_directives)
 from dmlp_tpu.check.baseline import (DEFAULT_NAME, diff_baseline,
                                      load_baseline, save_baseline)
+from dmlp_tpu.check.cache import CheckCache
 from dmlp_tpu.check.findings import RULES
 
 
@@ -50,6 +59,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--json", action="store_true",
                    help="pure-JSON verdict on stdout, narration on "
                         "stderr")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the per-file fingerprint cache")
+    p.add_argument("--stale-allows", action="store_true",
+                   help="report `# check: allow-*`/no-retry/"
+                        "no-traffic directives that no longer "
+                        "suppress any finding (exit 1 if any)")
     p.add_argument("--list-rules", action="store_true")
     args = p.parse_args(argv)
 
@@ -74,7 +89,33 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if os.path.exists(cand):
             baseline_path = cand
 
-    findings = analyze_paths(paths, families)
+    if args.stale_allows:
+        # Stale detection needs directive-use tracking from an actual
+        # rule run over EVERY family (cached verdicts carry no use
+        # info), so this mode always analyzes fresh.
+        _findings, modules = analyze_paths_tracking(
+            paths, list(ALL_FAMILIES))
+        stale_dirs = stale_allow_directives(modules)
+        if args.json:
+            json.dump({"check_schema": 1, "mode": "stale-allows",
+                       "paths": paths,
+                       "stale_allows": [
+                           {"path": pa, "line": ln, "directive": d}
+                           for pa, ln, d in stale_dirs],
+                       "ok": not stale_dirs}, sys.stdout, indent=2)
+            sys.stdout.write("\n")
+            out = sys.stderr
+        else:
+            out = sys.stdout
+        for pa, ln, d in stale_dirs:
+            print(f"STALE-ALLOW {pa}:{ln}: `# check: {d}` no longer "
+                  f"suppresses any finding — remove it", file=out)
+        print(f"dmlp_tpu.check --stale-allows: {len(stale_dirs)} stale "
+              f"directive(s)", file=out)
+        return 1 if stale_dirs else 0
+
+    cache = CheckCache(enabled=not args.no_cache)
+    findings = analyze_paths(paths, families, cache=cache)
 
     if args.write_baseline:
         out = baseline_path or os.path.join(repo_root(), DEFAULT_NAME)
@@ -100,6 +141,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "stale_baseline": [
                 {"rule": r, "path": pa, "scope": s, "key": k, "count": n}
                 for (r, pa, s, k), n in sorted(stale.items())],
+            "cache": {"enabled": cache.enabled, "hits": cache.hits,
+                      "misses": cache.misses},
             "ok": not new,
         }
         json.dump(verdict, sys.stdout, indent=2)

@@ -1,24 +1,34 @@
 """Analysis driver: walk sources, build cross-module facts, run rules.
 
-The collective rule needs package-wide context (declared ``*_AXIS``
-constants, ``obs/comms.py`` model names, axis-helper signatures), so
-analysis is two-phase: parse everything into :class:`ModuleInfo`, then
-run each family over each module. Unparseable files become a synthetic
-``R000`` finding rather than a crash — a syntax error in the tree is a
-finding, not an excuse to skip the gate.
+Cross-module context (declared ``*_AXIS`` constants, ``obs/comms.py``
+model names, metric registrations, the package lock graph) is needed by
+several families, so analysis is phased: reduce every file to its
+cacheable *facts* (:mod:`.facts`), merge them into one
+:class:`~dmlp_tpu.check.facts.PackageFacts`, then run each family over
+each module. Unparseable files become a synthetic ``R000`` finding
+rather than a crash — a syntax error in the tree is a finding, not an
+excuse to skip the gate.
+
+With a :class:`~dmlp_tpu.check.cache.CheckCache` (the CLI default),
+both phases key off content hashes: unchanged files load facts without
+re-parsing, and files whose (content, merged-facts digest, families)
+triple is cached skip rule execution entirely — ``make check`` re-runs
+only re-analyze what changed.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Iterable, List, Optional, Sequence
+from typing import Iterable, List, Optional, Sequence, Tuple
 
+from dmlp_tpu.check.cache import CheckCache, content_sha
 from dmlp_tpu.check.common import ModuleInfo
+from dmlp_tpu.check.facts import PackageFacts, module_facts
 from dmlp_tpu.check.findings import Finding
 
-ALL_FAMILIES = ("R0", "R1", "R2", "R3", "R4", "R5", "R6")
+ALL_FAMILIES = ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7")
 #: families make check enforces by default; R0 rides in `make lint`
-DEFAULT_FAMILIES = ("R1", "R2", "R3", "R4", "R5", "R6")
+DEFAULT_FAMILIES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
 
 
 def package_root() -> str:
@@ -72,11 +82,11 @@ def load_modules(paths: Sequence[str], root: Optional[str] = None
     return modules, findings
 
 
-def analyze_modules(modules: List[ModuleInfo],
-                    families: Optional[Sequence[str]] = None
-                    ) -> List[Finding]:
-    from dmlp_tpu.check.collectives import CollectiveRule
+def build_rules(facts: PackageFacts,
+                families: Optional[Sequence[str]] = None) -> list:
     from dmlp_tpu.check.compatrule import CompatRule
+    from dmlp_tpu.check.concurrency import ConcurrencyRule
+    from dmlp_tpu.check.collectives import CollectiveRule
     from dmlp_tpu.check.dispatchcost import DispatchCostRule
     from dmlp_tpu.check.hostsync import HostSyncRule
     from dmlp_tpu.check.hygiene import HygieneRule
@@ -85,14 +95,12 @@ def analyze_modules(modules: List[ModuleInfo],
     from dmlp_tpu.check.resilient import ResilientRule
 
     fams = set(families or DEFAULT_FAMILIES)
-    findings: List[Finding] = []
-    add = findings.append
     rules = []
     if "R0" in fams:
         rules.append(HygieneRule())
     if "R1" in fams:
-        rules.append(CollectiveRule(modules))
-        rules.append(DispatchCostRule(modules))
+        rules.append(CollectiveRule(facts))
+        rules.append(DispatchCostRule(facts))
     if "R2" in fams:
         rules.append(RecompileRule())
     if "R3" in fams:
@@ -102,7 +110,22 @@ def analyze_modules(modules: List[ModuleInfo],
     if "R5" in fams:
         rules.append(ResilientRule())
     if "R6" in fams:
-        rules.append(MetricNameRule(modules))
+        rules.append(MetricNameRule(facts))
+    if "R7" in fams:
+        rules.append(ConcurrencyRule(facts.concurrency))
+    return rules
+
+
+def analyze_modules(modules: List[ModuleInfo],
+                    families: Optional[Sequence[str]] = None,
+                    facts: Optional[PackageFacts] = None
+                    ) -> List[Finding]:
+    if facts is None:
+        facts = PackageFacts([(m.relpath, module_facts(m))
+                              for m in modules])
+    rules = build_rules(facts, families)
+    findings: List[Finding] = []
+    add = findings.append
     for mod in modules:
         for rule in rules:
             rule.run(mod, add)
@@ -112,12 +135,120 @@ def analyze_modules(modules: List[ModuleInfo],
 
 def analyze_paths(paths: Sequence[str],
                   families: Optional[Sequence[str]] = None,
-                  root: Optional[str] = None) -> List[Finding]:
+                  root: Optional[str] = None,
+                  cache: Optional[CheckCache] = None) -> List[Finding]:
+    if cache is not None and cache.enabled:
+        findings, _mods = _analyze_cached(paths, families, root, cache)
+        return findings
     modules, parse_findings = load_modules(paths, root=root)
     return parse_findings + analyze_modules(modules, families)
+
+
+def analyze_paths_tracking(paths: Sequence[str],
+                           families: Optional[Sequence[str]] = None,
+                           root: Optional[str] = None
+                           ) -> Tuple[List[Finding], List[ModuleInfo]]:
+    """Uncached analysis that also returns the analyzed modules (their
+    ``used_allows`` sets feed ``--stale-allows``)."""
+    modules, parse_findings = load_modules(paths, root=root)
+    return parse_findings + analyze_modules(modules, families), modules
+
+
+def _analyze_cached(paths, families, root, cache: CheckCache):
+    """The fingerprint-cached driver (see module docstring)."""
+    root = root or repo_root()
+    files: List[Tuple[str, str, bytes]] = []   # (path, rel, raw)
+    parse_findings: List[Finding] = []
+    for path in _iter_py_files(paths):
+        rel = _relpath(path, root)
+        try:
+            with open(path, "rb") as f:
+                files.append((path, rel, f.read()))
+        except OSError as e:
+            parse_findings.append(Finding(
+                "R000", rel, 0, 0, "", "unparseable",
+                f"cannot analyze: {e}"))
+    shas = {rel: content_sha(raw) for _p, rel, raw in files}
+    modules: dict = {}
+    fact_pairs: List[Tuple[str, dict]] = []
+    for path, rel, raw in files:
+        facts = cache.get_facts(shas[rel])
+        if facts is None:
+            mod = _parse(path, rel, raw, parse_findings)
+            if mod is None:
+                continue
+            modules[rel] = mod
+            facts = module_facts(mod)
+            cache.put_facts(shas[rel], facts)
+        fact_pairs.append((rel, facts))
+    merged = PackageFacts(fact_pairs)
+    ctx = merged.digest()
+    fam_key = ",".join(families or DEFAULT_FAMILIES)
+    rules = None
+    findings: List[Finding] = list(parse_findings)
+    for path, rel, raw in files:
+        if rel not in shas or not any(r == rel for r, _f in fact_pairs):
+            continue                       # unparseable: R000 already out
+        key = CheckCache.findings_key(rel, ctx, fam_key)
+        cached = cache.get_findings(shas[rel], key)
+        if cached is not None:
+            findings.extend(Finding(**{k: e[k] for k in (
+                "rule", "path", "line", "col", "scope", "key",
+                "message")}) for e in cached)
+            continue
+        mod = modules.get(rel) or _parse(path, rel, raw, parse_findings)
+        if mod is None:
+            continue
+        if rules is None:
+            rules = build_rules(merged, families)
+        mod_findings: List[Finding] = []
+        for rule in rules:
+            rule.run(mod, mod_findings.append)
+        cache.put_findings(shas[rel], key,
+                           [f.to_dict() for f in mod_findings])
+        findings.extend(mod_findings)
+    cache.flush()
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, modules
+
+
+def _parse(path, rel, raw, parse_findings) -> Optional[ModuleInfo]:
+    try:
+        return ModuleInfo(path, rel, raw.decode("utf-8"))
+    except (SyntaxError, UnicodeDecodeError) as e:
+        parse_findings.append(Finding(
+            "R000", rel, getattr(e, "lineno", 0) or 0, 0, "",
+            "unparseable", f"cannot analyze: {e}"))
+        return None
 
 
 def analyze_package(families: Optional[Sequence[str]] = None
                     ) -> List[Finding]:
     """Analyze the whole installed ``dmlp_tpu`` package."""
     return analyze_paths([package_root()], families)
+
+
+def stale_allow_directives(modules: List[ModuleInfo]
+                           ) -> List[Tuple[str, int, str]]:
+    """``(relpath, line, directive)`` for every suppression directive
+    that silenced nothing in the run that analyzed ``modules`` (run ALL
+    families first, or live directives for unrun families report
+    stale)."""
+    import re
+    from dmlp_tpu.check.findings import is_suppression_directive
+    # Prose in docstrings/messages mentions directives ("annotate
+    # `# check: no-retry`") and parse_directives deliberately picks
+    # those up (extra allows are harmless for suppression). For STALE
+    # reporting they would be noise, so only well-formed bare tokens
+    # count — the backticks/punctuation prose drags along fail this.
+    token_re = re.compile(r"^[a-z][a-z-]*(=[A-Za-z0-9]+)?$")
+    out: List[Tuple[str, int, str]] = []
+    for mod in modules:
+        for line, directives in sorted(mod.directives.items()):
+            for d in sorted(directives):
+                if not token_re.match(d) \
+                        or not is_suppression_directive(d):
+                    continue
+                if (line, d) not in mod.used_allows:
+                    out.append((mod.relpath, line, d))
+    return out

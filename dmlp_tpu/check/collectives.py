@@ -97,29 +97,15 @@ def resolve_axis(expr: ast.AST, mod: ModuleInfo,
 
 
 class CollectiveRule:
-    """One instance runs over the whole package (needs cross-module
-    facts: declared axes, obs/comms.py model names, axis-helper
-    signatures)."""
+    """One instance runs over the whole package: the cross-module
+    context (declared axes, obs/comms.py model names, axis-helper
+    signatures) comes from the merged PackageFacts."""
 
-    def __init__(self, modules: List[ModuleInfo]):
-        self.modules = modules
-        self.axis_consts: Dict[str, str] = {}
-        self.declared: Set[str] = set()
-        self.comms_models: Set[str] = set()
-        self.axis_helpers: Dict[str, int] = {}
-        for m in modules:
-            for name, val in m.str_consts.items():
-                if name.endswith("_AXIS"):
-                    self.axis_consts[name] = val
-                    self.declared.add(val)
-            if m.relpath.replace("\\", "/").endswith("obs/comms.py"):
-                for name, node in m.defs.items():
-                    self.comms_models.add(name)
-            for name, node in m.defs.items():
-                args = node.args.posonlyargs + node.args.args
-                for i, a in enumerate(args):
-                    if a.arg == "axis_name":
-                        self.axis_helpers[name] = i
+    def __init__(self, facts):
+        self.axis_consts: Dict[str, str] = facts.axis_consts
+        self.declared: Set[str] = facts.declared
+        self.comms_models: Set[str] = facts.comms_models
+        self.axis_helpers: Dict[str, int] = facts.axis_helpers
 
     # -- per-module ----------------------------------------------------------
     def run(self, mod: ModuleInfo, add) -> None:

@@ -96,6 +96,9 @@ class ModuleInfo:
         self.source = source
         self.tree = ast.parse(source, filename=path)
         self.directives = parse_directives(source)
+        #: (line, directive) pairs that suppressed (or would suppress)
+        #: a finding this run — consumed by ``--stale-allows``
+        self.used_allows: Set[Tuple[int, str]] = set()
         self.parents: Dict[ast.AST, ast.AST] = {}
         self.scopes: Dict[ast.AST, str] = {}
         self._link(self.tree, None, [])
@@ -154,8 +157,32 @@ class ModuleInfo:
         return lines
 
     def allowed(self, node: ast.AST, directive: str) -> bool:
-        return any(directive in self.directives.get(ln, ())
-                   for ln in self._directive_lines(node))
+        """Is ``node`` governed by ``directive``? A match is recorded in
+        :attr:`used_allows` — rules only consult this at would-be
+        finding sites, so the recorded set is exactly the directives
+        that still suppress something (the ``--stale-allows`` feed)."""
+        hit = False
+        for ln in self._directive_lines(node):
+            if directive in self.directives.get(ln, ()):
+                self.used_allows.add((ln, directive))
+                hit = True
+        return hit
+
+    def allowed_value(self, node: ast.AST, prefix: str,
+                      value: str) -> bool:
+        """Directive match for the ``<prefix>=<value>`` form (e.g.
+        ``allow-concurrency=R703``), also accepting the bare
+        ``<prefix>`` as a family-wide waiver. Matches are recorded for
+        stale-allow tracking like :meth:`allowed`."""
+        if self.allowed(node, prefix):
+            return True
+        scoped = f"{prefix}={value}"
+        hit = False
+        for ln in self._directive_lines(node):
+            if scoped in self.directives.get(ln, ()):
+                self.used_allows.add((ln, scoped))
+                hit = True
+        return hit
 
     def directive_values(self, node: ast.AST, prefix: str) -> List[str]:
         """Values of ``<prefix>=<value>`` directives governing ``node``."""

@@ -31,7 +31,6 @@ and tests measure what they please) and honor the R1 family's
 from __future__ import annotations
 
 import ast
-import os
 from typing import List, Optional, Set
 
 from dmlp_tpu.check.common import ModuleInfo, call_name
@@ -48,39 +47,26 @@ PROBE_CALLS = {"MeasuredIters", "_queue_iters"}
 
 def _modeled_kernels(modules: List[ModuleInfo]) -> Optional[Set[str]]:
     """Kernel function names registered in ``analytic_cost``'s model
-    table, parsed from obs/kernel_cost.py — the analyzed copy when it
-    is part of this run, else the installed package's file (fixture
-    runs analyze a single temp file). None when neither parses: R106
-    then stays silent rather than flagging every dispatch."""
+    table — the analyzed copy when obs/kernel_cost.py is part of this
+    run, else the installed package's file (fixture runs analyze a
+    single temp file). None when neither parses: R106 then stays silent
+    rather than flagging every dispatch. (Kept on the ModuleInfo
+    signature for introspection/tests; the analysis driver routes the
+    same extraction through the cacheable facts layer.)"""
+    from dmlp_tpu.check.facts import (_installed_modeled_kernels,
+                                      _modeled_from_tree)
     mod = next((m for m in modules
                 if m.relpath.endswith("obs/kernel_cost.py")), None)
-    tree = mod.tree if mod is not None else None
-    if tree is None:
-        try:
-            from dmlp_tpu.check.analyzer import package_root
-            path = os.path.join(package_root(), "obs", "kernel_cost.py")
-            with open(path, encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=path)
-        except (OSError, SyntaxError):
-            return None
-    names: Set[str] = set()
-    # the registry shape: models = {id(pallas_x.kernel_name): _entry, ...}
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Dict):
-            continue
-        for key in node.keys:
-            if isinstance(key, ast.Call) and call_name(key) == "id" \
-                    and key.args and isinstance(key.args[0],
-                                                ast.Attribute):
-                names.add(key.args[0].attr)
-    return names or None
+    if mod is None:
+        return _installed_modeled_kernels()
+    return set(_modeled_from_tree(mod.tree)) or None
 
 
 class DispatchCostRule:
     """R105/R106 over every engine-module ``record_dispatch`` site."""
 
-    def __init__(self, modules: List[ModuleInfo]):
-        self._modeled = _modeled_kernels(modules)
+    def __init__(self, facts):
+        self._modeled = facts.modeled_kernels
 
     # -- per-module tables ---------------------------------------------------
     def _ops_kernels(self, mod: ModuleInfo) -> dict:

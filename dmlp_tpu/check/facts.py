@@ -1,0 +1,147 @@
+"""Per-file cross-module facts — the analyzer's cacheable interface.
+
+The cross-module rule families (R1 collectives, R105/R106 dispatch
+cost, R6 metric names, R7 concurrency) need package-wide context. Before
+the fingerprint cache they dug it straight out of every parsed
+:class:`~dmlp_tpu.check.common.ModuleInfo`; now each file reduces to a
+small JSON-safe *facts* dict (:func:`module_facts` — a pure function of
+that one file's AST), and :class:`PackageFacts` merges the per-file
+dicts into the tables the rules consume. The split is what makes
+per-file caching sound: a file's findings depend only on (its own
+content, the merged facts), so the cache key is (content hash, facts
+digest) — see :mod:`dmlp_tpu.check.cache`.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from dmlp_tpu.check.common import ModuleInfo
+
+FACTS_SCHEMA = 1
+
+
+def module_facts(mod: ModuleInfo) -> Dict[str, Any]:
+    """JSON-safe cross-module facts for one file (content-only: no
+    paths inside, so a moved file keeps its facts)."""
+    from dmlp_tpu.check.concurrency import module_conc_facts
+    from dmlp_tpu.check.metricnames import registration_facts
+    axis_consts = {n: v for n, v in mod.str_consts.items()
+                   if n.endswith("_AXIS")}
+    axis_helpers: Dict[str, int] = {}
+    for name, node in mod.defs.items():
+        args = node.args.posonlyargs + node.args.args
+        for i, a in enumerate(args):
+            if a.arg == "axis_name":
+                axis_helpers[name] = i
+    return {
+        "facts_schema": FACTS_SCHEMA,
+        "axis_consts": axis_consts,
+        "defs": sorted(mod.defs),
+        "axis_helpers": axis_helpers,
+        "metric_sites": registration_facts(mod),
+        "modeled_kernels": _modeled_from_tree(mod.tree),
+        "concurrency": module_conc_facts(mod),
+    }
+
+
+def _modeled_from_tree(tree: ast.AST) -> List[str]:
+    """Kernel names keyed by ``id(pallas_x.kernel)`` in a model table —
+    only meaningful for obs/kernel_cost.py, but harmless elsewhere."""
+    from dmlp_tpu.check.common import call_name
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for key in node.keys:
+            if isinstance(key, ast.Call) and call_name(key) == "id" \
+                    and key.args and isinstance(key.args[0],
+                                                ast.Attribute):
+                names.add(key.args[0].attr)
+    return sorted(names)
+
+
+class PackageFacts:
+    """Merged package-wide context, built from (relpath, facts) pairs."""
+
+    def __init__(self, pairs: List[Tuple[str, Dict[str, Any]]]):
+        from dmlp_tpu.check.concurrency import ConcurrencyGraph
+        self.pairs = sorted(pairs)
+        self.axis_consts: Dict[str, str] = {}
+        self.declared: Set[str] = set()
+        self.comms_models: Set[str] = set()
+        self.axis_helpers: Dict[str, int] = {}
+        modeled: Set[str] = set()
+        saw_kernel_cost = False
+        metric_sites: List[Tuple[str, int, str, str]] = []
+        conc_pairs: List[Tuple[str, Dict[str, Any]]] = []
+        for rel, facts in self.pairs:
+            rel_n = rel.replace("\\", "/")
+            for name, val in facts.get("axis_consts", {}).items():
+                self.axis_consts[name] = val
+                self.declared.add(val)
+            if rel_n.endswith("obs/comms.py"):
+                self.comms_models.update(facts.get("defs", []))
+            for name, idx in facts.get("axis_helpers", {}).items():
+                self.axis_helpers[name] = idx
+            if rel_n.endswith("obs/kernel_cost.py"):
+                saw_kernel_cost = True
+                modeled.update(facts.get("modeled_kernels", []))
+            for seq, (name, kind) in enumerate(
+                    facts.get("metric_sites", [])):
+                metric_sites.append((rel, seq, name, kind))
+            conc_pairs.append((rel, facts.get("concurrency", {})))
+        #: literal metric name -> (kind, relpath) of its first
+        #: (path, document-order)-ranked registration (the R602
+        #: table). No line numbers anywhere in the facts: a pure line
+        #: shift in a metric-registering file must not change the
+        #: merged digest (and with it invalidate EVERY file's cached
+        #: verdict) — same rule the concurrency facts follow.
+        self.metric_first: Dict[str, Tuple[str, str]] = {}
+        for rel, seq, name, kind in sorted(
+                metric_sites, key=lambda s: (s[0], s[1])):
+            self.metric_first.setdefault(name, (kind, rel))
+        #: kernel model table; None = unknown (R106 stays silent). When
+        #: the analyzed set has no obs/kernel_cost.py (single-file
+        #: fixture runs), fall back to the installed package's copy —
+        #: context the per-file pairs don't carry, so it must ride in
+        #: the digest too (else an explicit-target cached run would
+        #: replay stale R106 verdicts after a kernel_cost.py edit).
+        self._fallback_models: Optional[List[str]] = None
+        if saw_kernel_cost:
+            self.modeled_kernels: Optional[Set[str]] = modeled or None
+        else:
+            self.modeled_kernels = _installed_modeled_kernels()
+            self._fallback_models = sorted(self.modeled_kernels or [])
+        self.concurrency = ConcurrencyGraph(conc_pairs)
+
+    def digest(self) -> str:
+        """Stable digest of the merged facts inputs — part of every
+        per-file findings cache key (a change to any file's FACTS
+        invalidates every file's findings; a facts-neutral edit only
+        invalidates the edited file)."""
+        blob = json.dumps([self.pairs, self._fallback_models],
+                          sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+
+def _installed_modeled_kernels() -> Optional[Set[str]]:
+    import os
+    try:
+        from dmlp_tpu.check.analyzer import package_root
+        path = os.path.join(package_root(), "obs", "kernel_cost.py")
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    names = set(_modeled_from_tree(tree))
+    return names or None
+
+
+def build_package_facts(modules: List[ModuleInfo]) -> PackageFacts:
+    """The no-cache path: facts straight from parsed modules."""
+    return PackageFacts([(m.relpath, module_facts(m)) for m in modules])

@@ -59,6 +59,15 @@ RULES = {
             " string (dynamic names fork unbounded series)",
     "R602": "metric name registered with conflicting kinds"
             " (counter vs gauge vs histogram)",
+    # R7 — concurrency discipline (threaded serving/telemetry surface)
+    "R701": "lock-order inversion: two locks acquired in opposite"
+            " orders across the package (potential deadlock)",
+    "R702": "guarded field accessed outside its lock (or a guarded"
+            " mutable escapes by reference)",
+    "R703": "blocking call (sleep, socket/subprocess wait, jax"
+            " readback, thread join) while holding a lock",
+    "R704": "thread started without a join/stop path or a daemon"
+            " declaration",
 }
 
 #: rule id -> allowlist directive that silences it at a call site.
@@ -70,7 +79,22 @@ ALLOW_DIRECTIVES = {
     "R4": "allow-compat",
     "R5": "no-retry",
     "R6": "allow-metric-name",
+    "R7": "allow-concurrency",
 }
+
+#: every directive that SUPPRESSES a finding (for ``--stale-allows``):
+#: the family allowlists plus the R1 traffic waiver. A directive of one
+#: of these kinds that no longer silences anything is stale and should
+#: be pruned. (``comms-model=``/``noqa`` are annotations, not
+#: suppressions — never reported stale here.) ``allow-concurrency``
+#: also matches its rule-scoped form ``allow-concurrency=R70x``.
+SUPPRESSION_DIRECTIVES = tuple(sorted(
+    set(ALLOW_DIRECTIVES.values()) | {"no-traffic"}))
+
+
+def is_suppression_directive(directive: str) -> bool:
+    base = directive.split("=", 1)[0]
+    return base in SUPPRESSION_DIRECTIVES
 
 
 def family(rule: str) -> str:

@@ -38,8 +38,12 @@ from typing import Set
 from dmlp_tpu.check.common import ModuleInfo, call_name
 from dmlp_tpu.check.findings import Finding
 
-#: path fragments that make a module a hot path for this family
-HOT_DIRS = ("dmlp_tpu/engine/", "dmlp_tpu/ops/", "dmlp_tpu/parallel/")
+#: path fragments that make a module a hot path for this family —
+#: serve/ joined when the resident engine's gate-stats readback turned
+#: out to carry a dead allowlist (the serving solve loop is exactly as
+#: sync-sensitive as the batch engines)
+HOT_DIRS = ("dmlp_tpu/engine/", "dmlp_tpu/ops/", "dmlp_tpu/parallel/",
+            "dmlp_tpu/serve/")
 
 #: call prefixes whose results live on device (taint seeds)
 DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "lax.")

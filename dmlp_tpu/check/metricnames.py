@@ -73,24 +73,27 @@ def _registration_sites(mod: ModuleInfo
     return out
 
 
-class MetricNameRule:
-    """Cross-module rule: prescans every module's registration sites so
-    R602 can see kind conflicts across files (same shape as
-    CollectiveRule)."""
+def registration_facts(mod: ModuleInfo) -> List[List[str]]:
+    """Cacheable per-file facts: ``[name, kind]`` in document order
+    for every literal registration (the cross-module R602 input). No
+    line numbers — facts must survive pure line shifts so one comment
+    edit does not invalidate every file's cached verdict."""
+    out = []
+    for node, kind, arg in _registration_sites(mod):
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append([arg.value, kind])
+    return out
 
-    def __init__(self, modules: List[ModuleInfo]):
-        # literal name -> (kind, relpath, line) of its FIRST
-        # (path, line)-ordered registration
-        self._first: Dict[str, Tuple[str, str, int]] = {}
-        sites = []
-        for mod in modules:
-            for node, kind, arg in _registration_sites(mod):
-                if isinstance(arg, ast.Constant) \
-                        and isinstance(arg.value, str):
-                    sites.append((mod.relpath, node.lineno, arg.value,
-                                  kind))
-        for relpath, line, name, kind in sorted(sites):
-            self._first.setdefault(name, (kind, relpath, line))
+
+class MetricNameRule:
+    """Cross-module rule: the package-wide first-registration table
+    (PackageFacts.metric_first) lets R602 see kind conflicts across
+    files."""
+
+    def __init__(self, facts):
+        # literal name -> (kind, relpath) of its FIRST
+        # (path, document-order)-ranked registration
+        self._first: Dict[str, Tuple[str, str]] = facts.metric_first
 
     def run(self, mod: ModuleInfo, add) -> None:
         for node, kind, arg in _registration_sites(mod):
@@ -118,6 +121,6 @@ class MetricNameRule:
                     "R602", mod.relpath, node.lineno, node.col_offset,
                     mod.scope_of(node), f"{literal}:{kind}vs{first[0]}",
                     f"metric {literal!r} registered here as {kind} but "
-                    f"as {first[0]} at {first[1]}:{first[2]} — one "
+                    f"as {first[0]} in {first[1]} — one "
                     "name, one kind (the registry raises at runtime "
                     "only on the colliding path)"))

@@ -8,9 +8,11 @@ can eat an :class:`InjectedTransientError` or a real RESOURCE_EXHAUSTED
 before the retry/ladder machinery ever classifies it, turning a
 recoverable fault into a silently wrong or silently degraded run.
 
-Scope: modules inside ``dmlp_tpu/resilience/`` plus any module that
-imports ``dmlp_tpu.resilience`` (i.e. paths actually wrapped by the
-layer). A handler is compliant when it catches something narrower than
+Scope: modules inside ``dmlp_tpu/resilience/`` and ``dmlp_tpu/serve/``
+(the serving daemon's per-request error paths swallow by design and
+must say so), plus any module that imports ``dmlp_tpu.resilience``
+(i.e. paths actually wrapped by the layer). A handler is compliant
+when it catches something narrower than
 ``Exception``/``BaseException``, re-raises (any ``raise`` in its body),
 or is annotated ``# check: no-retry`` — the annotation documents "this
 swallow is deliberate and out of the retry path" (observability
@@ -64,7 +66,8 @@ def _reraises(handler: ast.ExceptHandler) -> bool:
 
 
 def in_resilient_scope(mod: ModuleInfo) -> bool:
-    if mod.relpath.replace("\\", "/").startswith("dmlp_tpu/resilience/"):
+    rel = mod.relpath.replace("\\", "/")
+    if rel.startswith(("dmlp_tpu/resilience/", "dmlp_tpu/serve/")):
         return True
     return any(src.startswith("dmlp_tpu.resilience")
                for src in mod.imports.values())

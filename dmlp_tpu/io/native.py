@@ -57,6 +57,11 @@ def _load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("DMLP_TPU_NO_NATIVE"):
             return None
+        # check: allow-concurrency=R703 — the g++ build under the lock
+        # is the once-guard: this runs at most ONCE per process (_tried
+        # flips first), and concurrent parsers must block until the .so
+        # exists rather than racing the compiler or falling back to the
+        # slow Python parser mid-build.
         if not _build():
             return None
         try:

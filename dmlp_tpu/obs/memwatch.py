@@ -79,10 +79,10 @@ def device_memory_stats() -> Optional[List[Optional[Dict[str, Any]]]]:
         for d in jax.devices():
             try:
                 out.append(d.memory_stats())
-            except Exception:  # check: no-retry — a device without the
-                out.append(None)  # API is a None entry, not a failure
+            except Exception:  # a device without the API is a
+                out.append(None)   # None entry, not a failure
         return out
-    except Exception:  # check: no-retry — observability never raises
+    except Exception:  # observability never raises
         return None
 
 
@@ -94,7 +94,7 @@ def live_array_bytes() -> Optional[int]:
     try:
         import jax
         return int(sum(a.nbytes for a in jax.live_arrays()))
-    except Exception:  # check: no-retry — observability never raises
+    except Exception:  # observability never raises
         return None
 
 
@@ -368,7 +368,7 @@ def note_engine_model(engine, inp) -> Optional[Dict[str, Any]]:
         telemetry.registry().gauge("mem.model.resident_bytes").set(
             model["total_bytes"])
         return model
-    except Exception:  # check: no-retry — observability never fails a solve
+    except Exception:  # observability never fails a solve
         engine.last_mem_model = None
         return None
 

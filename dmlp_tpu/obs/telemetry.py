@@ -206,6 +206,31 @@ class Histogram:
         with self._lock:
             return self._sum
 
+    @staticmethod
+    def _quantile_from(counts: List[int], count: int, mn: float,
+                       mx: float, q: float) -> float:
+        """Quantile math over one CONSISTENT state copy — quantile()
+        and snapshot() both route through this so a concurrent
+        observe() between two lock acquisitions can never mix counts
+        from one state with min/max from another."""
+        if count == 0:
+            return math.nan
+        rank = q * (count - 1) + 1              # 1-based sample rank
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                break
+        if i == 0:
+            lo, hi = min(mn, HIST_LO), HIST_LO
+        elif i >= _NBUCKETS:
+            lo, hi = _BOUNDS[-1], mx
+        else:
+            lo, hi = _BOUNDS[i - 1], _BOUNDS[i]
+        lo, hi = max(lo, 1e-12), max(hi, 1e-12)
+        est = math.sqrt(lo * hi)
+        return min(max(est, mn), mx)
+
     def quantile(self, q: float) -> float:
         """Bounded-error quantile estimate (see HIST_QUANTILE_REL_ERROR):
         the geometric midpoint of the bucket holding the q-th sample,
@@ -213,23 +238,9 @@ class Histogram:
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile {q} outside [0, 1]")
         with self._lock:
-            if self._count == 0:
-                return math.nan
-            rank = q * (self._count - 1) + 1        # 1-based sample rank
-            cum = 0
-            for i, c in enumerate(self._counts):
-                cum += c
-                if cum >= rank:
-                    break
-            if i == 0:
-                lo, hi = min(self._min, HIST_LO), HIST_LO
-            elif i >= _NBUCKETS:
-                lo, hi = _BOUNDS[-1], self._max
-            else:
-                lo, hi = _BOUNDS[i - 1], _BOUNDS[i]
-            lo, hi = max(lo, 1e-12), max(hi, 1e-12)
-            est = math.sqrt(lo * hi)
-            return min(max(est, self._min), self._max)
+            counts = list(self._counts)
+            count, mn, mx = self._count, self._min, self._max
+        return self._quantile_from(counts, count, mn, mx, q)
 
     def bucket_counts(self) -> List[Tuple[float, int]]:
         """(upper bound, cumulative count) pairs, ending with +Inf."""
@@ -243,7 +254,14 @@ class Histogram:
             return out
 
     def snapshot(self) -> Dict[str, Any]:
+        # ONE lock acquisition for the whole snapshot: computing the
+        # quantiles via self.quantile() would re-lock per call, so a
+        # concurrent observe() between p50 and p99 could yield
+        # quantiles from a different distribution than count/min/max
+        # in the same snapshot (the bucket-update-vs-snapshot-read
+        # race the R7 audit called out).
         with self._lock:
+            counts = list(self._counts)
             count, total = self._count, self._sum
             mn, mx = self._min, self._max
         out: Dict[str, Any] = {"kind": self.kind, "count": count,
@@ -251,9 +269,11 @@ class Histogram:
         if self.unit:
             out["unit"] = self.unit
         if count:
-            out.update(min=mn, max=mx,
-                       p50=self.quantile(0.5), p95=self.quantile(0.95),
-                       p99=self.quantile(0.99))
+            out.update(
+                min=mn, max=mx,
+                p50=self._quantile_from(counts, count, mn, mx, 0.5),
+                p95=self._quantile_from(counts, count, mn, mx, 0.95),
+                p99=self._quantile_from(counts, count, mn, mx, 0.99))
         return out
 
 
@@ -642,29 +662,39 @@ class Sampler:
         with self._lock:
             if self._thread is not None and self._thread.is_alive():
                 return                       # idempotent
-            self._stop.clear()
+            # Each loop gets its OWN stop event, bound at start: with a
+            # shared event, stop();start() racing from two threads
+            # could clear the flag before the old loop observed it and
+            # leave two sampler loops running (found by check R702's
+            # first run over this class).
+            stop = threading.Event()
+            self._stop = stop
             self._thread = threading.Thread(
-                target=self._loop, name="telemetry-sampler", daemon=True)
+                target=self._loop, args=(stop,),
+                name="telemetry-sampler", daemon=True)
             self._thread.start()
 
     def stop(self) -> None:
         with self._lock:
             t = self._thread
             self._thread = None
+            self._stop.set()     # the event BOUND to t's loop; setting
+            #                      it under the lock orders against a
+            #                      concurrent start()'s rebind
         if t is None:
             return                           # idempotent
-        self._stop.set()
         t.join(timeout=5.0)
 
     @property
     def running(self) -> bool:
-        t = self._thread
+        with self._lock:
+            t = self._thread
         return t is not None and t.is_alive()
 
-    def _loop(self) -> None:
-        while not self._stop.is_set():
+    def _loop(self, stop: threading.Event) -> None:
+        while not stop.is_set():
             self.sample_now()
-            self._stop.wait(self.interval_s)
+            stop.wait(self.interval_s)
 
     def sample_now(self) -> None:
         """One synchronous sampling tick — also exposed so the engines

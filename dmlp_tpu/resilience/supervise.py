@@ -54,7 +54,7 @@ def start_heartbeat(path: str, interval_s: float = 0.5) -> threading.Event:
                 with open(path, "a"):
                     os.utime(path, None)
             except OSError:
-                pass  # check: no-retry — a beat miss only ages the file
+                pass  # a beat miss only ages the file
             stop.wait(interval_s)
 
     threading.Thread(target=_beat, daemon=True,
@@ -85,7 +85,7 @@ def _kill_all(procs: List[subprocess.Popen]) -> None:
         try:
             p.wait(timeout=10)
         except subprocess.TimeoutExpired:
-            pass  # check: no-retry — already killed; nothing left to do
+            pass  # already killed; nothing left to do
 
 
 def run_supervised(make_cluster: Callable[[int], List[List[str]]],
@@ -142,11 +142,22 @@ def run_supervised(make_cluster: Callable[[int], List[List[str]]],
                            "collective)")
                 break
             now = time.time()
-            stale = [i for i in range(len(procs))
-                     if rcs[i] is None
-                     and os.path.exists(heartbeat_file(hb_dir, i))
-                     and now - os.path.getmtime(
-                         heartbeat_file(hb_dir, i)) > hb_stale_s]
+            stale = []
+            for i in range(len(procs)):
+                if rcs[i] is not None:
+                    continue
+                # Single stat, no exists()+getmtime() TOCTOU: the rank
+                # process owns the file and a relaunch sweeps the
+                # attempt dir, so it can vanish between the two calls —
+                # the old two-step read crashed the supervisor exactly
+                # when a rank died mid-poll (R7 audit).
+                try:
+                    mtime = os.path.getmtime(heartbeat_file(hb_dir, i))
+                except OSError:
+                    continue        # no beat yet (or swept): the
+                    #                 cluster deadline covers it
+                if now - mtime > hb_stale_s:
+                    stale.append(i)
             if stale:
                 failure = (f"heartbeat stale (> {hb_stale_s:.3g}s) for "
                            f"rank(s) {stale}")

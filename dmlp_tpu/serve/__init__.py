@@ -34,6 +34,20 @@ path: the resident solves reuse the engines' candidates -> host-f64
 finalize -> boundary-hazard repair pipeline unchanged.
 """
 
+# Race-sanitizer hook BEFORE the imports below pull in batching/
+# admission/engine (and transitively obs.telemetry + resilience.stats),
+# whose import creates module-level locks: `python -m dmlp_tpu.serve`
+# executes this __init__ first, so this is the earliest point where
+# DMLP_TPU_RACECHECK=1 can wrap the lock factories and have EVERY
+# serving-surface lock tracked (telemetry's import-time globals are
+# retrofitted by install either way).
+import os as _os
+
+if _os.environ.get("DMLP_TPU_RACECHECK") == "1":
+    from dmlp_tpu.check import racecheck as _racecheck
+
+    _racecheck.install()
+
 from dmlp_tpu.serve.admission import AdmissionController  # noqa: F401
 from dmlp_tpu.serve.batching import MicroBatcher, Request  # noqa: F401
 from dmlp_tpu.serve.engine import (CapacityError, ResidentEngine,  # noqa: F401

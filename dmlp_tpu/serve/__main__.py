@@ -96,6 +96,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "oom fault is the injected memory squeeze)")
     args = p.parse_args(argv)
 
+    # The actual DMLP_TPU_RACECHECK=1 install happens in
+    # dmlp_tpu/serve/__init__.py — which `python -m dmlp_tpu.serve`
+    # executes BEFORE this module, i.e. before the serving imports
+    # create any locks. This call is an idempotent backstop for
+    # embedders who import __main__.main directly;
+    # DMLP_TPU_RACECHECK_OUT collects the verdict at drain (the
+    # `make race-smoke` harness reads it).
+    from dmlp_tpu.check import racecheck
+    racecheck.install_from_env()
+
     from dmlp_tpu.config import EngineConfig
     from dmlp_tpu.io.grammar import parse_input
     from dmlp_tpu.resilience import inject as rs_inject
@@ -135,6 +145,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.ready_file:
             daemon.write_ready_file(args.ready_file)
         daemon.run_until_drained()
+        racecheck.write_report_if_requested()
         sys.stderr.write("dmlp_tpu.serve: drained clean\n")
         return 0
     except Exception:

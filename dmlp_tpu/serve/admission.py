@@ -59,7 +59,13 @@ class AdmissionController:
         self.batch_queries_cap = batch_queries_cap or max_request_queries
         self.max_k = min(max_k or engine.max_k, engine.max_k)
         self.draining = False
+        # Guards the memoized resident-model total: decide_queued()
+        # (under the batcher's queue lock) and snapshot() (handler
+        # threads, no batcher lock) both read it — without the guard
+        # two threads could interleave the (chunks_staged, total)
+        # check-then-write. Leaf lock: nothing is acquired under it.
         self._lock = threading.Lock()
+        self._model_cache = None
 
     @staticmethod
     def _auto_budget() -> Optional[int]:
@@ -91,11 +97,13 @@ class AdmissionController:
     def _resident_model_bytes(self) -> int:
         """The corpus-only model total, cached — it only moves when the
         extract chunks stage (every other input is fixed at engine
-        construction), and decide() runs under the batcher's queue
-        lock, so rebuilding the dict per request is pure hot-path
-        waste."""
+        construction), so rebuilding the dict per request is pure
+        hot-path waste. The memo is read both under the batcher's
+        queue lock (decide_queued) and from handler threads
+        (snapshot), hence its own guard."""
         chunks_staged = self.engine._chunks is not None
-        cached = getattr(self, "_model_cache", None)
+        with self._lock:
+            cached = self._model_cache
         if cached is not None and cached[0] == chunks_staged:
             return cached[1]
         model = memwatch.resident_bytes_model(
@@ -105,7 +113,8 @@ class AdmissionController:
                             if chunks_staged else 0),
             chunk_rows=self.engine._ex_chunk_rows)
         total = int(model["total_bytes"])
-        self._model_cache = (chunks_staged, total)
+        with self._lock:
+            self._model_cache = (chunks_staged, total)
         return total
 
     def headroom_bytes(self) -> Optional[int]:
@@ -122,54 +131,77 @@ class AdmissionController:
 
     # -- the decision ----------------------------------------------------------
 
-    def decide(self, nq: int, kmax: int, queued_queries: int,
-               queued_kmax: int = 0) -> Dict[str, Any]:
-        """One admission decision; returns ``{"verdict", "reason",
-        ...}`` and records it in the registry either way.
-        ``queued_queries``/``queued_kmax`` describe the work already
-        admitted and waiting: the memory check prices the micro-batch
-        this request would actually COALESCE into (bounded by the
-        batcher's cap), not the request alone."""
+    def precheck(self, nq: int, kmax: int) -> Optional[Dict[str, Any]]:
+        """The request-local half of admission: shape/k caps plus the
+        ``serve.admit`` injection hook. Reads no queue state — and MAY
+        BLOCK (an injected straggler ``delay`` fault sleeps here), so
+        the batcher calls it OUTSIDE its queue lock (check rule R703:
+        a sleep under the lock would stall every submitter and the
+        consumer). Returns a rejection dict, or None to proceed to the
+        queue-state checks. Counters are recorded by decide_queued —
+        exactly once per decision."""
+        if nq < 1 or nq > self.max_request_queries:
+            return {"verdict": REJECT, "reason": "shape"}
+        if kmax < 1 or kmax > self.max_k:
+            return {"verdict": REJECT, "reason": "k_too_large"}
+        try:
+            rs_inject.fire("serve.admit", nq=nq, k=kmax)
+        except Exception as e:
+            # An injected RESOURCE_EXHAUSTED here IS the memory
+            # squeeze: treat the budget as swallowed. Anything else
+            # is a real bug and must propagate.
+            if classify(e) != "oom":
+                raise
+            return {"verdict": REJECT, "reason": "injected_squeeze"}
+        return None
+
+    def decide_queued(self, nq: int, kmax: int, queued_queries: int,
+                      queued_kmax: int = 0,
+                      prechecked: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+        """The queue-state half: draining, queue depth, and the memory
+        pricing of the micro-batch this request would COALESCE into
+        (bounded by the batcher's cap; ``queued_queries``/
+        ``queued_kmax`` describe the admitted-and-waiting work). Pure
+        state reads and arithmetic — safe under the batcher's queue
+        lock, which is what makes decision + enqueue atomic (two
+        concurrent submits must not both price against the same queue
+        state). ``prechecked`` is :meth:`precheck`'s verdict; the one
+        admitted/rejected counter bump per decision happens here."""
         reg = telemetry.registry()
         verdict, reason = ACCEPT, "ok"
         if self.draining:
             verdict, reason = REJECT, "draining"
-        elif nq < 1 or nq > self.max_request_queries:
-            verdict, reason = REJECT, "shape"
-        elif kmax < 1 or kmax > self.max_k:
-            verdict, reason = REJECT, "k_too_large"
+        elif prechecked is not None:
+            verdict, reason = prechecked["verdict"], prechecked["reason"]
         elif queued_queries + nq > self.max_queue_queries:
             verdict, reason = REJECT, "queue_full"
-        else:
-            squeeze = False
-            try:
-                rs_inject.fire("serve.admit", nq=nq, k=kmax)
-            except Exception as e:
-                # An injected RESOURCE_EXHAUSTED here IS the memory
-                # squeeze: treat the budget as swallowed. Anything else
-                # is a real bug and must propagate.
-                if classify(e) != "oom":
-                    raise
-                squeeze = True
-            if squeeze:
-                verdict, reason = REJECT, "injected_squeeze"
-            elif self.budget_bytes is not None:
-                # Priced only when a budget exists: decide() runs under
-                # the batcher's queue lock, and a no-budget backend
-                # (memory shedding off) must not pay the model per
-                # request for a comparison that can never fire.
-                headroom = self.headroom_bytes()
-                eff_nq = min(queued_queries + nq,
-                             max(self.batch_queries_cap, nq))
-                need = self.batch_bytes(eff_nq, max(kmax, queued_kmax))
-                reg.gauge("serve.headroom_bytes").set(headroom)
-                if need > headroom:
-                    verdict, reason = REJECT, "memory"
+        elif self.budget_bytes is not None:
+            # Priced only when a budget exists: a no-budget backend
+            # (memory shedding off) must not pay the model per
+            # request for a comparison that can never fire.
+            headroom = self.headroom_bytes()
+            eff_nq = min(queued_queries + nq,
+                         max(self.batch_queries_cap, nq))
+            need = self.batch_bytes(eff_nq, max(kmax, queued_kmax))
+            reg.gauge("serve.headroom_bytes").set(headroom)
+            if need > headroom:
+                verdict, reason = REJECT, "memory"
         if verdict == ACCEPT:
             reg.counter("serve.admitted").inc()
         else:
             reg.counter("serve.rejected").inc(label=reason)
         return {"verdict": verdict, "reason": reason, "nq": nq, "k": kmax}
+
+    def decide(self, nq: int, kmax: int, queued_queries: int,
+               queued_kmax: int = 0) -> Dict[str, Any]:
+        """One standalone admission decision (tests, non-batcher
+        callers): precheck + queue-state checks in order. The batcher
+        composes the halves itself so the blocking half runs outside
+        its queue lock."""
+        return self.decide_queued(
+            nq, kmax, queued_queries, queued_kmax=queued_kmax,
+            prechecked=self.precheck(nq, kmax))
 
     def snapshot(self) -> Dict[str, Any]:
         reg = telemetry.registry()

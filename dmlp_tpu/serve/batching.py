@@ -89,13 +89,21 @@ class MicroBatcher:
 
     def submit(self, req: Request) -> Dict[str, Any]:
         """Admission decision + enqueue; returns the decision dict.
-        Rejected requests complete immediately with the reason."""
+        Rejected requests complete immediately with the reason.
+
+        Two-phase admission: the request-local half (shape caps + the
+        ``serve.admit`` injection hook, which may SLEEP for a straggler
+        fault) runs before the queue lock; only the queue-state half —
+        pure reads + arithmetic — runs under it, keeping decision +
+        enqueue atomic without a blocking call under the lock (check
+        rule R703 enforces this split statically)."""
         if req.kind == "query":
             kmax = int(req.ks.max()) if req.nq else 0
+            pre = self.admission.precheck(req.nq, kmax)
             with self._cond:
-                decision = self.admission.decide(
+                decision = self.admission.decide_queued(
                     req.nq, kmax, self._queued_queries,
-                    queued_kmax=self._queued_kmax)
+                    queued_kmax=self._queued_kmax, prechecked=pre)
                 if decision["verdict"] == ACCEPT:
                     self._queue.append(req)
                     self._queued_queries += req.nq
@@ -120,12 +128,23 @@ class MicroBatcher:
     # -- consumer side ---------------------------------------------------------
 
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop = False
-        self._thread = threading.Thread(target=self._run_loop,
-                                        name="serve-batcher", daemon=True)
-        self._thread.start()
+        # The whole check-then-spawn is one critical section: two
+        # concurrent start() calls (or start() racing stop()) must not
+        # each observe `_thread is None` and spawn TWO consumer loops —
+        # the single-consumer invariant is what lets the engine run
+        # lock-free. `_stop` is likewise guarded state (the consumer
+        # reads it under the lock in _collect/_run_loop).
+        with self._cond:
+            if self._thread is not None:
+                return
+            self._stop = False
+            t = self._thread = threading.Thread(
+                target=self._run_loop, name="serve-batcher",
+                daemon=True)
+            # started inside the guard so a racing stop() can never
+            # grab an un-started handle (join would raise); the new
+            # consumer just blocks on the lock until we release
+            t.start()
 
     def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
         """Stop the batcher thread. ``drain=True`` finishes everything
@@ -137,11 +156,17 @@ class MicroBatcher:
                 while self._queue:
                     self._queue.popleft().complete(error="shutdown")
                 self._queued_queries = 0
+                self._queued_kmax = 0   # the queue is empty: a stale
+                #            kmax would over-price the next admission
+                #            if this batcher is ever restarted
             self._cond.notify_all()
-        t = self._thread
+            t = self._thread
+            self._thread = None     # handle handoff under the lock;
+            #                         the join itself must NOT hold it
+            #                         (the consumer needs the lock to
+            #                         finish draining — check R703)
         if t is not None:
             t.join(timeout=timeout)
-            self._thread = None
 
     def _collect(self) -> List[Request]:
         """Block for work, then drain the queue up to the batch cap —
@@ -216,7 +241,11 @@ class MicroBatcher:
                 r.complete(error=msg)
             return
         ms = (time.perf_counter() - t0) * 1e3
-        self.batches += 1
+        with self._cond:
+            # handler threads read `batches` through daemon.stats()
+            # while this consumer increments it — guard the write so
+            # the field has one discipline (reads are single int loads)
+            self.batches += 1
         reg.counter("serve.batches").inc()
         reg.histogram("serve.batch_latency_ms", unit="ms").observe(ms)
         reg.histogram("serve.batch_queries").observe(total)

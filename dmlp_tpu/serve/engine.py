@@ -588,9 +588,16 @@ class ResidentEngine(SingleChipEngine):
     # -- introspection --------------------------------------------------------
 
     def bucket_stats(self) -> Dict[str, object]:
+        # Snapshot the bucket table FIRST: handler threads call this
+        # through daemon.stats() while the batcher thread may be
+        # inserting a new bucket — iterating the live dict twice could
+        # raise "dict changed size" or return paths/buckets from two
+        # different states. list() of a dict is a single atomic read
+        # under the GIL; the engine stays single-writer.
+        entries = list(self._buckets.values())
         return {
-            "buckets": sorted(e.key for e in self._buckets.values()),
-            "paths": {e.key: e.path for e in self._buckets.values()},
+            "buckets": sorted(e.key for e in entries),
+            "paths": {e.key: e.path for e in entries},
             "compile_count": self.compile_count,
             "bucket_compile_ms": dict(self.bucket_compile_ms),
             "cold_start_compile_ms": self.cold_start_compile_ms,

@@ -27,6 +27,16 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ["DMLP_TPU_TUNE_CACHE"] = os.path.join(
     os.sep, "nonexistent", "dmlp-tpu-test-tune-cache.json")
 
+# Same hermeticity for the static-analysis fingerprint cache
+# (dmlp_tpu.check.cache): tests that shell out to `python -m
+# dmlp_tpu.check` must neither read a developer's warm ~/.cache verdict
+# nor pollute it with fixture-tree entries. Content-hash keying makes
+# cross-test sharing of this scratch dir safe.
+import tempfile  # noqa: E402
+
+os.environ["DMLP_TPU_CHECK_CACHE"] = os.path.join(
+    tempfile.gettempdir(), "dmlp-tpu-test-check-cache")
+
 # The hook may have latched jax_platforms=axon into jax.config before this
 # file ran; both the config and the factory must go.
 from dmlp_tpu.utils.platform import honor_cpu_request  # noqa: E402

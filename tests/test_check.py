@@ -768,6 +768,398 @@ class TestR6MetricNames:
 
 
 # ---------------------------------------------------------------------------
+# R7 — concurrency discipline
+# ---------------------------------------------------------------------------
+
+
+class TestR7Concurrency:
+    def test_r701_inversion_across_functions(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            LOCK_A = threading.Lock()
+            LOCK_B = threading.Lock()
+            def f():
+                with LOCK_A:
+                    with LOCK_B:
+                        pass
+            def g():
+                with LOCK_B:
+                    with LOCK_A:
+                        pass
+        """)
+        fs = run_check(tmp_path, ["R7"])
+        assert "R701" in rules_of(fs)
+        assert any("inverts" in f.message for f in fs)
+
+    def test_r701_consistent_order_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            LOCK_A = threading.Lock()
+            LOCK_B = threading.Lock()
+            def f():
+                with LOCK_A:
+                    with LOCK_B:
+                        pass
+            def g():
+                with LOCK_A:
+                    with LOCK_B:
+                        pass
+        """)
+        assert run_check(tmp_path, ["R7"]) == []
+
+    def test_r701_cross_module_inversion_via_call(self, tmp_path):
+        # a holds A and calls b's taker (A->B); b holds B and calls
+        # a's taker (B->A): the cycle spans modules and call chains.
+        write(tmp_path, "dmlp_tpu/serve/a.py", """
+            import threading
+            from dmlp_tpu.serve.b import take_b
+            LOCK_A = threading.Lock()
+            def take_a():
+                with LOCK_A:
+                    pass
+            def f():
+                with LOCK_A:
+                    take_b()
+        """)
+        write(tmp_path, "dmlp_tpu/serve/b.py", """
+            import threading
+            from dmlp_tpu.serve.a import take_a
+            LOCK_B = threading.Lock()
+            def take_b():
+                with LOCK_B:
+                    pass
+            def g():
+                with LOCK_B:
+                    take_a()
+        """)
+        fs = run_check(tmp_path, ["R7"])
+        assert rules_of(fs).count("R701") >= 2  # both edges flagged
+
+    def test_r701_nested_nonreentrant_self_deadlock(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """)
+        fs = run_check(tmp_path, ["R7"])
+        assert "R701" in rules_of(fs)
+        assert any("self-deadlock" in f.message for f in fs)
+
+    def test_r702_unguarded_read_of_guarded_field(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                def add(self):
+                    with self._lock:
+                        self.n += 1
+                def peek(self):
+                    return self.n
+        """)
+        fs = run_check(tmp_path, ["R7"])
+        assert "R702" in rules_of(fs)
+        assert any("self.n" in f.message for f in fs)
+
+    def test_r702_guarded_access_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                def add(self):
+                    with self._lock:
+                        self.n += 1
+                def peek(self):
+                    with self._lock:
+                        return self.n
+        """)
+        assert run_check(tmp_path, ["R7"]) == []
+
+    def test_r702_mutable_escape_by_reference(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+                def add(self, x):
+                    with self._lock:
+                        self._items = self._items + [x]
+                def items(self):
+                    with self._lock:
+                        return self._items
+        """)
+        fs = run_check(tmp_path, ["R7"])
+        assert "R702" in rules_of(fs)
+        assert any("escape" in f.key for f in fs)
+
+    def test_r702_copy_return_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+                def add(self, x):
+                    with self._lock:
+                        self._items = self._items + [x]
+                def items(self):
+                    with self._lock:
+                        return list(self._items)
+        """)
+        assert run_check(tmp_path, ["R7"]) == []
+
+    def test_r702_allow_directive_with_invariant(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                def add(self):
+                    with self._lock:
+                        self.n += 1
+                def peek(self):
+                    # check: allow-concurrency=R702 — racy int read is
+                    # benign: single GIL load, monitoring only
+                    return self.n
+        """)
+        assert run_check(tmp_path, ["R7"]) == []
+
+    def test_r703_sleep_under_lock(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            import time
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def run(self):
+                    with self._lock:
+                        time.sleep(0.1)
+        """)
+        fs = run_check(tmp_path, ["R7"])
+        assert "R703" in rules_of(fs)
+
+    def test_r703_call_mediated_sleep_under_lock(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            import time
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def _nap(self):
+                    time.sleep(0.01)
+                def run(self):
+                    with self._lock:
+                        self._nap()
+        """)
+        fs = run_check(tmp_path, ["R7"])
+        assert "R703" in rules_of(fs)
+        assert any("_nap" in f.message for f in fs)
+
+    def test_r703_sleep_outside_lock_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            import time
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def run(self):
+                    with self._lock:
+                        n = 1
+                    time.sleep(0.1)
+                    return n
+        """)
+        assert run_check(tmp_path, ["R7"]) == []
+
+    def test_r703_condition_wait_on_held_lock_clean(self, tmp_path):
+        # cond.wait RELEASES the held lock — the legal blocking wait.
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            class Q:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.items = []
+                def get(self):
+                    with self._cond:
+                        while not self.items:
+                            self._cond.wait(timeout=0.1)
+                        return self.items.pop()
+        """)
+        fs = run_check(tmp_path, ["R7"])
+        assert "R703" not in rules_of(fs)
+
+    def test_r704_thread_without_daemon_or_join(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            def go(f):
+                t = threading.Thread(target=f)
+                t.start()
+        """)
+        assert "R704" in rules_of(run_check(tmp_path, ["R7"]))
+
+    def test_r704_daemon_thread_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            def go(f):
+                threading.Thread(target=f, daemon=True).start()
+        """)
+        assert run_check(tmp_path, ["R7"]) == []
+
+    def test_r704_joined_thread_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/serve/x.py", """
+            import threading
+            def go(f):
+                t = threading.Thread(target=f)
+                t.start()
+                t.join()
+        """)
+        assert run_check(tmp_path, ["R7"]) == []
+
+
+# ---------------------------------------------------------------------------
+# --stale-allows + the fingerprint cache
+# ---------------------------------------------------------------------------
+
+
+class TestStaleAllows:
+    def test_dead_directive_reported_live_one_kept(self, tmp_path):
+        from dmlp_tpu.check.analyzer import (analyze_paths_tracking,
+                                             stale_allow_directives)
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            import jax
+            def live(arr):
+                return jax.device_get(arr)  # check: allow-host-sync
+            def dead(arr):
+                return arr  # check: allow-host-sync
+        """)
+        _fs, mods = analyze_paths_tracking(
+            [str(tmp_path)], ["R0", "R1", "R2", "R3", "R4", "R5", "R6",
+                              "R7"], root=str(tmp_path))
+        stale = stale_allow_directives(mods)
+        assert [(ln, d) for _p, ln, d in stale] == \
+            [(6, "allow-host-sync")]
+
+    def test_prose_mentions_not_reported(self, tmp_path):
+        from dmlp_tpu.check.analyzer import (analyze_paths_tracking,
+                                             stale_allow_directives)
+        write(tmp_path, "dmlp_tpu/obs/x.py", '''
+            def f():
+                """Docs may say annotate `# check: no-retry` freely."""
+                return 1
+        ''')
+        _fs, mods = analyze_paths_tracking(
+            [str(tmp_path)], ["R5"], root=str(tmp_path))
+        assert stale_allow_directives(mods) == []
+
+    def test_cli_stale_allows_json(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            def dead(arr):
+                return arr  # check: allow-host-sync
+        """)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "dmlp_tpu.check", "--stale-allows",
+             "--json", str(tmp_path / "dmlp_tpu")],
+            capture_output=True, text=True, env=env)
+        assert r.returncode == 1
+        verdict = json.loads(r.stdout)
+        assert verdict["ok"] is False
+        assert verdict["stale_allows"][0]["directive"] == \
+            "allow-host-sync"
+
+
+VIOLATION_R1 = """
+import jax
+def f(x):
+    return jax.lax.psum(x, "bogus")
+"""
+
+
+class TestFingerprintCache:
+    def _cache(self, tmp_path):
+        from dmlp_tpu.check.cache import CheckCache
+        return CheckCache(directory=str(tmp_path / "cache"),
+                          enabled=True)
+
+    def test_second_run_hits_and_findings_identical(self, tmp_path):
+        from dmlp_tpu.check.analyzer import analyze_paths
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/ops/x.py", VIOLATION_R1)
+        c1 = self._cache(tmp_path)
+        cold = analyze_paths([str(tmp_path)], ["R1"],
+                             root=str(tmp_path), cache=c1)
+        assert c1.misses == 2 and c1.hits == 0
+        c2 = self._cache(tmp_path)
+        warm = analyze_paths([str(tmp_path)], ["R1"],
+                             root=str(tmp_path), cache=c2)
+        assert c2.hits == 2 and c2.misses == 0
+        assert [f.fingerprint() for f in warm] == \
+            [f.fingerprint() for f in cold]
+        assert "R101" in rules_of(warm)
+
+    def test_edit_invalidates_only_the_changed_file(self, tmp_path):
+        from dmlp_tpu.check.analyzer import analyze_paths
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        src = write(tmp_path, "dmlp_tpu/ops/x.py", VIOLATION_R1)
+        analyze_paths([str(tmp_path)], ["R1"], root=str(tmp_path),
+                      cache=self._cache(tmp_path))
+        # facts-neutral edit (a comment): only x.py re-analyzes
+        with open(src) as f:
+            body = f.read()
+        open(src, "w").write("# shifted\n" + body)
+        c = self._cache(tmp_path)
+        fs = analyze_paths([str(tmp_path)], ["R1"], root=str(tmp_path),
+                           cache=c)
+        assert c.hits == 1 and c.misses == 1
+        assert "R101" in rules_of(fs)
+        # the fix lands -> cached verdict must NOT resurrect the finding
+        write(tmp_path, "dmlp_tpu/ops/x.py", """
+            import jax
+            def f(x):
+                return jax.lax.psum(x, "data")  # check: no-traffic
+        """)
+        fs2 = analyze_paths([str(tmp_path)], ["R1"], root=str(tmp_path),
+                            cache=self._cache(tmp_path))
+        assert fs2 == []
+
+    def test_facts_change_invalidates_everyone(self, tmp_path):
+        from dmlp_tpu.check.analyzer import analyze_paths
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/ops/x.py", VIOLATION_R1)
+        analyze_paths([str(tmp_path)], ["R1"], root=str(tmp_path),
+                      cache=self._cache(tmp_path))
+        # declaring the axis changes mesh.py's FACTS: the other file's
+        # cached (now wrong) verdict must be invalidated too
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py",
+              MESH_SRC + 'BOGUS_AXIS = "bogus"\n')
+        c = self._cache(tmp_path)
+        fs = analyze_paths([str(tmp_path)], ["R1"], root=str(tmp_path),
+                           cache=c)
+        assert fs == []              # the axis is declared now
+        assert c.hits == 0           # every findings entry missed
+
+    def test_disabled_cache_is_noop(self, tmp_path):
+        from dmlp_tpu.check.analyzer import analyze_paths
+        from dmlp_tpu.check.cache import CheckCache
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/ops/x.py", VIOLATION_R1)
+        c = CheckCache(directory=str(tmp_path / "cache"), enabled=False)
+        fs = analyze_paths([str(tmp_path)], ["R1"], root=str(tmp_path),
+                           cache=c)
+        assert "R101" in rules_of(fs)
+        assert not (tmp_path / "cache").exists()
+
+
+# ---------------------------------------------------------------------------
 # R0 — hygiene (the ruff-subset fallback behind make lint)
 # ---------------------------------------------------------------------------
 

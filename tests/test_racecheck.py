@@ -1,0 +1,187 @@
+"""dmlp_tpu.check.racecheck — the runtime race sanitizer (dynamic R7).
+
+The load-bearing property is TEETH: a seeded lock-order inversion and a
+seeded blocking-call-under-lock must be caught, and a disciplined
+consistent-order run must come back clean — otherwise the race-smoke
+harness's empty verdict over the real daemon proves nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from dmlp_tpu.check import racecheck
+
+
+@pytest.fixture
+def sanitizer():
+    """Installed sanitizer with guaranteed restore: a leaked patch of
+    threading.Lock would contaminate every later test in the
+    process."""
+    racecheck.install()
+    racecheck.reset()
+    try:
+        yield racecheck
+    finally:
+        racecheck.reset()
+        racecheck.uninstall()
+
+
+def test_install_uninstall_restore_factories():
+    orig_lock = threading.Lock
+    orig_sleep = time.sleep
+    racecheck.install()
+    try:
+        assert threading.Lock is not orig_lock
+        assert racecheck.enabled()
+        assert racecheck.install()       # idempotent
+    finally:
+        racecheck.uninstall()
+    assert threading.Lock is orig_lock
+    assert time.sleep is orig_sleep
+    assert not racecheck.enabled()
+    racecheck.uninstall()                # idempotent
+
+
+def test_seeded_inversion_is_caught(sanitizer):
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    r = sanitizer.report()
+    assert r["inversions"] == 1
+    v = [x for x in r["violations"] if x["kind"] == "inversion"][0]
+    assert v["held"] != v["acquiring"]
+    assert "reverse_site" in v
+    assert not r["ok"]
+
+
+def test_cross_thread_inversion_is_caught(sanitizer):
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    th = threading.Thread(target=t1, daemon=True)
+    th.start()
+    th.join()
+    with b:
+        with a:        # opposite order, different thread
+            pass
+    assert sanitizer.report()["inversions"] == 1
+
+
+def test_consistent_order_and_reentrant_use_clean(sanitizer):
+    a = threading.Lock()
+    b = threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    r = sanitizer.report()
+    assert r["ok"] and r["edges"] == 1
+
+
+def test_sleep_under_lock_caught_and_clean_sleep_ignored(sanitizer):
+    lk = threading.Lock()
+    time.sleep(0.001)                 # no lock held: clean
+    assert sanitizer.report()["ok"]
+    with lk:
+        time.sleep(0.001)
+    r = sanitizer.report()
+    assert r["blocking_under_lock"] == 1
+    v = r["violations"][0]
+    assert v["call"] == "time.sleep" and v["held"]
+
+
+def test_thread_join_under_lock_caught(sanitizer):
+    lk = threading.Lock()
+    t = threading.Thread(target=lambda: None, daemon=True)
+    t.start()
+    with lk:
+        t.join()
+    r = sanitizer.report()
+    assert r["blocking_under_lock"] == 1
+    assert r["violations"][0]["call"] == "Thread.join"
+
+
+def test_condition_wait_releases_held_tracking(sanitizer):
+    """cond.wait releases the lock: a timeout-wait under the condition
+    must not count as blocking-under-lock, and the handoff must
+    restore the held stack for the code after wait()."""
+    cond = threading.Condition()
+    lk = threading.Lock()
+    with cond:
+        cond.wait(timeout=0.01)
+        with lk:                      # still inside the cond guard
+            pass
+    r = sanitizer.report()
+    assert r["ok"]
+    assert r["edges"] == 1            # cond -> lk recorded after wait
+
+
+def test_condition_producer_consumer_clean(sanitizer):
+    cond = threading.Condition()
+    items = []
+    got = []
+
+    def consumer():
+        with cond:
+            while not items:
+                cond.wait(timeout=1.0)
+            got.append(items.pop())
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    time.sleep(0.02)
+    with cond:
+        items.append(7)
+        cond.notify()
+    t.join(timeout=5)
+    assert not t.is_alive() and got == [7]
+    assert sanitizer.report()["ok"]
+
+
+def test_reset_clears_graph_and_violations(sanitizer):
+    a = threading.Lock()
+    with a:
+        time.sleep(0.001)
+    assert not sanitizer.report()["ok"]
+    sanitizer.reset()
+    r = sanitizer.report()
+    assert r["ok"] and r["edges"] == 0 and r["violations"] == []
+
+
+def test_write_report_if_requested(sanitizer, tmp_path, monkeypatch):
+    out = tmp_path / "RACECHECK.json"
+    monkeypatch.setenv(racecheck.RACECHECK_OUT_ENV, str(out))
+    a = threading.Lock()
+    with a:
+        pass
+    path = sanitizer.write_report_if_requested()
+    assert path == str(out)
+    doc = json.loads(out.read_text())
+    assert doc["racecheck_schema"] == 1 and doc["ok"] is True
+
+
+def test_install_from_env(monkeypatch):
+    monkeypatch.delenv(racecheck.RACECHECK_ENV, raising=False)
+    assert racecheck.install_from_env() is False
+    monkeypatch.setenv(racecheck.RACECHECK_ENV, "1")
+    try:
+        assert racecheck.install_from_env() is True
+        assert racecheck.enabled()
+    finally:
+        racecheck.reset()
+        racecheck.uninstall()

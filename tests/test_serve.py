@@ -485,6 +485,141 @@ def test_daemon_serve_record_round_trips_ledger(tmp_path):
     assert any(p["round"] == 99 for p in entry["points"])
 
 
+# -- concurrent serving: parallel query + ingest + drain ----------------------
+
+
+def test_concurrent_query_ingest_drain_parity():
+    """Parallel query, ingest, and drain connections against ONE
+    daemon: every served request's checksums must equal the solo
+    solve/golden oracle (today's other daemon tests serialize their
+    requests). Ingested rows sit FAR outside the query envelope, so
+    the original-corpus oracle is exact under any interleaving — the
+    batcher's one consumer thread serializes corpus mutation against
+    solves, and this test is the proof."""
+    corpus = make_corpus(n=800, seed=17)
+    header = {"serve_trace_schema": 1,
+              "corpus": {"num_attrs": 5, "min_attr": -10,
+                         "max_attr": 10}}
+    wave1 = [{"nq": 1 + (w * 5 + i) % 6, "k": 1 + (w + i) % 6,
+              "seed": 9000 + w * 100 + i}
+             for w in range(3) for i in range(6)]
+    wave2 = [{"nq": 2, "k": 3, "seed": 9900 + i} for i in range(6)]
+    golden1 = sc.golden_reference(corpus, header, wave1)
+    golden2 = sc.golden_reference(corpus, header, wave2)
+    d = ServeDaemon(corpus, EngineConfig(), port=0, tick_s=0.001,
+                    warm_buckets=[(8, 8), (16, 8)])
+    d.start()
+    errors, results = [], {}
+    try:
+        # -- wave 1: 3 query workers + 1 ingest worker, fully parallel
+        def query_worker(w):
+            try:
+                cli = sc.ServeClient(d.port)
+                try:
+                    for i in range(6):
+                        idx = w * 6 + i
+                        req = wave1[idx]
+                        r = cli.query(
+                            sc.materialize_queries(req, header),
+                            ks=[int(v) for v in
+                                sc.request_ks(req)],
+                            req_id=str(idx))
+                        results[idx] = r
+                finally:
+                    cli.close()
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(f"worker {w}: {e}")
+
+        def ingest_worker():
+            try:
+                rng = np.random.default_rng(3)
+                cli = sc.ServeClient(d.port)
+                try:
+                    for _ in range(4):
+                        rows = 1e6 + rng.uniform(0, 1, (3, 5))
+                        r = cli.ingest([0, 1, 2], rows)
+                        if not r.get("ok"):
+                            errors.append(f"ingest: {r}")
+                finally:
+                    cli.close()
+            except Exception as e:  # pragma: no cover
+                errors.append(f"ingest: {e}")
+
+        threads = [threading.Thread(target=query_worker, args=(w,),
+                                    daemon=True) for w in range(3)]
+        threads.append(threading.Thread(target=ingest_worker,
+                                        daemon=True))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads), "wave 1 hung"
+        assert not errors, errors
+        for idx, want in enumerate(golden1):
+            r = results[idx]
+            assert r.get("ok"), f"request {idx} failed: {r}"
+            assert r["checksums"] == want, \
+                f"request {idx} diverged from the solo solve"
+        assert d.engine.n_real == 800 + 4 * 3
+
+        # -- wave 2: more queries RACING an in-band drain; every
+        # response is either correct or an explicit draining rejection,
+        # and queued work still completes (the drain contract)
+        out2 = {}
+
+        def late_worker(i):
+            try:
+                cli = sc.ServeClient(d.port)
+                try:
+                    req = wave2[i]
+                    out2[i] = cli.query(
+                        sc.materialize_queries(req, header),
+                        ks=[int(v) for v in sc.request_ks(req)],
+                        req_id=f"late{i}")
+                finally:
+                    cli.close()
+            except (ConnectionError, OSError):
+                # A connection the daemon never ACCEPTED can be reset
+                # by the drain — a legal shed, distinct from losing an
+                # admitted request's response (which the drain must
+                # never do, asserted below).
+                out2[i] = {"ok": False, "error": "rejected: draining "
+                                                 "(connection reset)"}
+            except Exception as e:  # pragma: no cover
+                errors.append(f"late {i}: {e}")
+
+        drainer = sc.ServeClient(d.port)
+        late = [threading.Thread(target=late_worker, args=(i,),
+                                 daemon=True) for i in range(6)]
+        for t in late:
+            t.start()
+        assert drainer.drain()["draining"]
+        drainer.close()
+        runner = threading.Thread(target=d.run_until_drained,
+                                  daemon=True)
+        runner.start()
+        for t in late:
+            t.join(timeout=300)
+        runner.join(timeout=300)
+        assert not runner.is_alive(), "drain hung under load"
+        assert not errors, errors
+        served = 0
+        for i, r in sorted(out2.items()):
+            if r.get("ok"):
+                served += 1
+                assert r["checksums"] == golden2[i], \
+                    f"late request {i} diverged during drain"
+            else:
+                assert "draining" in r.get("error", ""), r
+        assert d._inflight == 0
+        # the drain waited for every accepted request's response
+        assert served + sum(1 for r in out2.values()
+                            if not r.get("ok")) == len(wave2)
+    finally:
+        if not d._drain_event.is_set():
+            d.close()
+
+
 # -- telemetry drain hook (the PR 9 SIGTERM clean-drain satellite) ------------
 
 def test_sigterm_drain_hook_skips_flight_dump(tmp_path):
