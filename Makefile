@@ -7,7 +7,7 @@ CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
 .PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
 	perf-gate check lint chaos-smoke telemetry-smoke serve-smoke \
-	race-smoke serve-bench clean
+	race-smoke prune-smoke serve-bench clean
 
 all: native
 
@@ -17,7 +17,7 @@ native/_fastparse.so: native/fastparse.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
 test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint \
-	chaos-smoke telemetry-smoke serve-smoke race-smoke
+	chaos-smoke telemetry-smoke serve-smoke race-smoke prune-smoke
 	python -m pytest tests/ -q
 
 # Static analysis + runtime-sanitizer smoke (README "Static analysis &
@@ -198,6 +198,21 @@ race-smoke:
 	JAX_PLATFORMS=cpu python -m dmlp_tpu.check --families R7 \
 	  --no-baseline
 	JAX_PLATFORMS=cpu python tools/race_stress.py --out outputs/race
+
+# Pruned two-stage solve smoke (README "Pruned two-stage solve"): a
+# norm-banded corpus through the real CLI in DMLP_TPU_PRUNE=1/0 arms —
+# both byte-identical to the f64 golden model, the pruned arm must
+# prune > 0.5 of the blocks and stream < 0.5x the dense bytes (read
+# from the metrics summary's prune block), scan.bytes_streamed must be
+# visible in the OpenMetrics scrape, and a seeded oom schedule must
+# step the degrade ladder prune->fused with byte-identical recovery.
+# Then the capacity tool's --cpu-smoke proves the same scanned-bytes
+# ratio on its banded beyond-HBM stand-in shape.
+prune-smoke:
+	mkdir -p outputs/prune
+	JAX_PLATFORMS=cpu python tools/prune_smoke.py --out outputs/prune
+	JAX_PLATFORMS=cpu BENCH_OUT=outputs/prune/CAPACITY_PRUNE_SMOKE.json \
+	  python tools/capacity_beyond_hbm.py --cpu-smoke > /dev/null
 
 # Serving throughput bench (not in `make test`; emits the SERVE_rNN
 # ledger rounds): replay inputs/serve_trace1.jsonl against the daemon

@@ -287,6 +287,7 @@ def run_config(config_id: int, base_dir: str = ".",
                profile_dir: Optional[str] = None,
                obs_overhead: bool = False,
                fused_ab: bool = False,
+               prune_ab: bool = False,
                telemetry_dir: Optional[str] = None) -> dict:
     """Full benchmark flow for one config; returns a result summary dict.
 
@@ -517,6 +518,24 @@ def run_config(config_id: int, base_dir: str = ".",
             cfg, input_path, outputs_dir, out, mode=mode, fast=fast,
             timeout_s=timeout_s, env=env, pairs=n_reps,
             oracle_want=want if check_reps else None))
+    if prune_ab:
+        prune_res = _measure_prune_ab(
+            cfg, input_path, outputs_dir, out, mode=mode, fast=fast,
+            timeout_s=timeout_s, env=env, pairs=n_reps,
+            oracle_want=want if check_reps else None)
+        res.update(prune_res)
+        if record_path:
+            # A dedicated kind="prune" RunRecord so the A/B lands in
+            # the ledger's ``prune/configN/...`` family (gated by
+            # tools/perf_gate.py) alongside the plain bench record.
+            import dataclasses as _dc
+
+            from dmlp_tpu.obs.run import RunRecord, round_from_name
+            RunRecord(kind="prune", tool="dmlp_tpu.bench",
+                      config=_dc.asdict(cfg), metrics=dict(prune_res),
+                      device="cpu" if cpu_pinned else None,
+                      round=round_from_name(record_path)
+                      ).append_jsonl(record_path)
     if record_path:
         _append_run_record(record_path, cfg, res, trace_dir,
                            profile=profile, cpu_pinned=cpu_pinned,
@@ -706,6 +725,128 @@ def _measure_fused_ab(cfg: BenchConfig, input_path: str,
         out.write(f"Config {cfg.config_id}: fused A/B {pct:+.1f}% "
                   f"(median {med_t} -> {med_f} ms over "
                   f"{len(times['fused'])} interleaved pair(s), "
+                  "byte-identical)\n")
+    return res
+
+
+def _measure_prune_ab(cfg: BenchConfig, input_path: str,
+                      outputs_dir: str, out: TextIO,
+                      mode: Optional[str], fast: bool,
+                      timeout_s: float, env: Optional[dict],
+                      pairs: int, oracle_want: Optional[str]) -> dict:
+    """Interleaved pruned vs dense engine timings: ``DMLP_TPU_PRUNE=1``
+    against ``=0``, order alternating per pair (the repo's A/B
+    weathering methodology). The record carries:
+
+    - ``engine_ms_pruned`` / ``engine_ms_dense`` medians plus raw
+      ``*_reps`` lists (ledger per-trial evidence -> a gated
+      ``prune/configN/...`` series);
+    - ``scanned_bytes_pruned`` / ``scanned_bytes_dense`` /
+      ``scanned_bytes_ratio`` from the engines' scan accounting
+      (ops.summaries.note_scan via the CLI metrics summary) — the
+      bytes claim as a checked number, both ways;
+    - ``prune_ab_identical``: every pruned-arm stdout byte-equal to
+      every dense-arm stdout (and the oracle in exact mode) — the
+      pruned solve's byte-identity contract, CHECKED per run;
+    - ``prune_ab_vacuous`` when the pruned arm pruned zero blocks
+      (e.g. a uniform corpus, where no block is provably out of every
+      top-k): the timings/bytes still record — a ratio of 1.0 on a
+      shape pruning cannot help is an honest measurement, unlike the
+      fused A/B's identical-code case — but the flag says so.
+
+    Never raises: failures record ``prune_ab_unavailable``."""
+    import json
+    import statistics
+
+    if cfg.procs > 1:
+        return {"prune_ab_unavailable": "multi-process config (the A/B "
+                "drives the single-process engine CLI)"}
+    base_env = dict(env if env is not None else os.environ)
+    arm_env = {"pruned": "1", "dense": "0"}
+    times: dict = {a: [] for a in arm_env}
+    outputs: dict = {a: set() for a in arm_env}
+    metrics_paths = {
+        arm: os.path.join(outputs_dir,
+                          f"prune_ab_metrics_{arm}_c{cfg.config_id}.jsonl")
+        for arm in arm_env}
+    for mpath in metrics_paths.values():
+        if os.path.exists(mpath):   # metrics JSONL appends; start clean
+            os.remove(mpath)
+    try:
+        for rep in range(max(pairs, 1)):
+            order = ("dense", "pruned") if rep % 2 == 0 \
+                else ("pruned", "dense")
+            for arm in order:
+                e = dict(base_env)
+                e["DMLP_TPU_PRUNE"] = arm_env[arm]
+                out_path, err_path = run_engine(
+                    cfg, input_path, outputs_dir, mode=mode, fast=fast,
+                    timeout_s=timeout_s, env=e,
+                    obs_flags=["--metrics", metrics_paths[arm]])
+                with open(out_path) as f:
+                    outputs[arm].add(f.read())
+                with open(err_path) as f:
+                    ms = _extract_ms(f.read())
+                if ms is None:
+                    return {"prune_ab_unavailable":
+                            f"no timing line in the {arm}-arm run"}
+                times[arm].append(ms)
+    except (EngineTimeout, RuntimeError) as e:
+        return {"prune_ab_unavailable":
+                f"engine run failed during the A/B: {e}"}
+    identical = (len(outputs["pruned"]) == 1
+                 and outputs["pruned"] == outputs["dense"]
+                 and (oracle_want is None
+                      or outputs["pruned"] == {oracle_want}))
+    if not identical:
+        return {"prune_ab_unavailable":
+                "pruned/dense stdout MISMATCH — byte-identity contract "
+                "violated; timings withheld", "prune_ab_identical": False}
+    prune_blocks: dict = {}
+    for arm, mpath in metrics_paths.items():
+        try:
+            with open(mpath) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec.get("event") == "summary" \
+                            and isinstance(rec.get("prune"), dict):
+                        prune_blocks[arm] = rec["prune"]
+        except (OSError, ValueError) as e:
+            return {"prune_ab_identical": True,
+                    "prune_ab_unavailable":
+                        f"{arm}-arm metrics channel unreadable: {e}"}
+    if set(prune_blocks) != set(arm_env):
+        return {"prune_ab_identical": True,
+                "prune_ab_unavailable":
+                    "no scan-accounting block in the A/B metrics "
+                    "channel — cannot attribute scanned bytes to arms"}
+    med_p = statistics.median(times["pruned"])
+    med_d = statistics.median(times["dense"])
+    sb_p = int(prune_blocks["pruned"].get("scanned_bytes", 0))
+    sb_d = int(prune_blocks["dense"].get("scanned_bytes", 0))
+    res = {"prune_ab_identical": True,
+           "engine_ms_pruned": round(med_p),
+           "engine_ms_pruned_reps": times["pruned"],
+           "engine_ms_dense": round(med_d),
+           "engine_ms_dense_reps": times["dense"],
+           "scanned_bytes_pruned": sb_p,
+           "scanned_bytes_dense": sb_d,
+           "prune_blocks_total": prune_blocks["pruned"].get(
+               "blocks_total"),
+           "prune_blocks_pruned": prune_blocks["pruned"].get(
+               "blocks_pruned", 0)}
+    if sb_d:
+        res["scanned_bytes_ratio"] = round(sb_p / sb_d, 4)
+    if not res["prune_blocks_pruned"]:
+        res["prune_ab_vacuous"] = True
+    if med_d > 0:
+        pct = (med_p - med_d) / med_d * 100.0
+        res["prune_ab_pct"] = round(pct, 2)
+        out.write(f"Config {cfg.config_id}: prune A/B {pct:+.1f}% "
+                  f"(median {med_d} -> {med_p} ms, scanned bytes "
+                  f"{sb_d} -> {sb_p}, "
+                  f"{res['prune_blocks_pruned']}/"
+                  f"{res['prune_blocks_total']} blocks pruned, "
                   "byte-identical)\n")
     return res
 
@@ -1026,6 +1167,14 @@ def main(argv=None) -> int:
                         "engine_ms_fused / engine_ms_two_pass (+ raw "
                         "rep lists) in the config's RunRecord "
                         "(single-process configs)")
+    p.add_argument("--prune-ab", action="store_true",
+                   help="A/B the pruned two-stage solve: run "
+                        "interleaved DMLP_TPU_PRUNE=1/0 engine pairs, "
+                        "verify the arms byte-identical, and record "
+                        "engine_ms_pruned / engine_ms_dense plus "
+                        "scanned-bytes both ways (+ raw rep lists) as "
+                        "a kind=\"prune\" RunRecord per config "
+                        "(single-process configs)")
     p.add_argument("--serve-trace", metavar="FILE", default=None,
                    help="recorded query trace for the serve mode "
                         "(default inputs/serve_trace1.jsonl)")
@@ -1057,6 +1206,7 @@ def main(argv=None) -> int:
                          profile_dir=args.profile_dir,
                          obs_overhead=args.obs_overhead,
                          fused_ab=args.fused_ab,
+                         prune_ab=args.prune_ab,
                          telemetry_dir=args.telemetry_dir)
         # `timed_out` is a marker, not a verdict (markers never gate):
         # the config's RunRecord documents the hang; a wrong checksum

@@ -81,7 +81,8 @@ def make_engine(config: EngineConfig, stderr=None):
 def _emit_metrics(path: str, args, inp, timer: EngineTimer, phase_ms: dict,
                   counters: Optional[dict], comms: Optional[dict],
                   extract_impl: Optional[str] = None,
-                  mem_model: Optional[dict] = None) -> None:
+                  mem_model: Optional[dict] = None,
+                  prune: Optional[dict] = None) -> None:
     """Append per-phase records + one run summary to the metrics JSONL.
 
     The summary is the contract record: it always carries a ``counters``
@@ -117,6 +118,11 @@ def _emit_metrics(path: str, args, inp, timer: EngineTimer, phase_ms: dict,
             # explicit mem_stats_unavailable marker where the backend
             # reports no memory, never silence.
             summary["mem"] = mem_model
+        if prune is not None:
+            # Scanned-bytes + prune accounting of the pruned two-stage
+            # solve (ops.summaries.note_scan) — the bench --prune-ab
+            # harness and `make prune-smoke` read these per arm.
+            summary["prune"] = prune
         # Recovery is never silent: when the resilience layer did
         # anything (or a fault schedule was installed, even if nothing
         # fired), the summary carries the counters the chaos harness
@@ -378,7 +384,9 @@ def _run_cli(parser, args, stdin, stdout, stderr, tracer, probe) -> int:
                           extract_impl=getattr(engine, "last_extract_impl",
                                                None)
                           if engine is not None else None,
-                          mem_model=mem_model)
+                          mem_model=mem_model,
+                          prune=getattr(engine, "last_prune", None)
+                          if engine is not None else None)
         if args.counters:
             _emit_counters_stderr(counters, timer.elapsed_ms, stderr)
         if tracer is not None:

@@ -110,6 +110,9 @@ class ShardedEngine:
         # Analytic per-device peak-HBM model of the last solve
         # (obs.memwatch); populated only under a telemetry session.
         self.last_mem_model = None
+        # Pruned two-stage solve accounting (ops.summaries.note_scan);
+        # None until a staging path runs.
+        self.last_prune = None
 
     def _np_dtype(self):
         """Wire dtype from the engine's (possibly no_auto_coarsen-swapped)
@@ -127,6 +130,14 @@ class ShardedEngine:
         # transfer wait lands in "fetch" like the other enqueue phases.
         self.last_phase_ms["stage_enqueue"] = \
             (_time.perf_counter() - t0) * 1e3
+        # Monolithic staging is by definition a dense scan; record it so
+        # the scanned-bytes series covers every path (ops.summaries).
+        from dmlp_tpu.ops.summaries import note_scan
+        dense = inp.params.num_data * inp.params.num_attrs \
+            * np.dtype(self._np_dtype()).itemsize
+        note_scan(self, scanned_bytes=dense, dense_bytes=dense,
+                  blocks_total=self.mesh.devices.shape[0],
+                  blocks_pruned=0)
         return out
 
     def _shard_inputs_inner(self, inp: KNNInput, data_block: int,
@@ -299,8 +310,16 @@ class ShardedEngine:
             from dmlp_tpu.ops.pallas_fused import fused_topk
             kern = fused_topk if impl == "fused" else extract_topk
 
-            def local(cd, ci, chunk_a, q_attrs, sc):
+            def local(cd, ci, chunk_a, q_attrs, sc, live):
+                # ``live`` is the per-shard prune mask of this chunk
+                # (P("data")-sharded, (1,) per cell): a pruned shard's
+                # piece arrives zero-filled and folds with n_real = 0 —
+                # every id masks to the sentinel, so the fold is a
+                # provable no-op (each shard prunes locally before its
+                # fold; the cross-shard merge is unchanged). Dense
+                # solves pass all-ones.
                 id_base, n_real = _chunk_span(sc, chunk_a.shape[0])
+                n_real = jnp.where(live[0] > 0, n_real, 0)
                 od, oi, its = kern(q_attrs, chunk_a, cd[0], ci[0],
                                    n_real=n_real, id_base=id_base,
                                    kc=k, interpret=interpret)
@@ -314,7 +333,8 @@ class ShardedEngine:
                 local, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, QUERY_AXIS, None),
                           P(DATA_AXIS, QUERY_AXIS, None),
-                          P(DATA_AXIS, None), P(QUERY_AXIS, None), P()),
+                          P(DATA_AXIS, None), P(QUERY_AXIS, None), P(),
+                          P(DATA_AXIS)),
                 out_specs=(P(DATA_AXIS, QUERY_AXIS, None),
                            P(DATA_AXIS, QUERY_AXIS, None),
                            P(DATA_AXIS, QUERY_AXIS)),
@@ -379,9 +399,10 @@ class ShardedEngine:
             from dmlp_tpu.ops.topk import make_block_step
             use_pallas = self.config.use_pallas
 
-            def local(cd, cl, ci, chunk_a, qo, lab_g, sc):
+            def local(cd, cl, ci, chunk_a, qo, lab_g, sc, live):
                 ck = chunk_a.shape[0]
                 id_base, n_real = _chunk_span(sc, ck)
+                n_real = jnp.where(live[0] > 0, n_real, 0)
                 iota = jnp.arange(ck, dtype=jnp.int32)
                 bids = jnp.where(iota < n_real, id_base + iota, -1)
                 blabels = _labels_for_ids(bids, lab_g)
@@ -397,7 +418,7 @@ class ShardedEngine:
                           P(DATA_AXIS, QUERY_AXIS, None),
                           P(DATA_AXIS, QUERY_AXIS, None),
                           P(DATA_AXIS, None), P(QUERY_AXIS, None),
-                          P(), P()),
+                          P(), P(), P(DATA_AXIS)),
                 out_specs=(P(DATA_AXIS, QUERY_AXIS, None),
                            P(DATA_AXIS, QUERY_AXIS, None),
                            P(DATA_AXIS, QUERY_AXIS, None)),
@@ -424,7 +445,36 @@ class ShardedEngine:
                 check_vma=False))
         return self._fns[key]
 
-    def _solve_chunked_extract(self, inp: KNNInput, routed: bool = True):
+    def _plan_prune_mesh(self, inp: KNNInput, r: int, shard_rows: int,
+                         nchunks: int, chunk_rows: int,
+                         allow_prune: bool):
+        """Stage 0+1 for the mesh chunk driver: per-(shard, chunk)
+        survivor mask ((R, T) bool) + stats, or (None, None) when
+        pruning is inactive. Blocks are each shard's chunk-aligned
+        contiguous global row ranges — exactly what _chunk_span folds —
+        scored against ALL queries (every data shard meets every query
+        shard across the mesh columns)."""
+        n = inp.params.num_data
+        if (not allow_prune or not self.config.exact or n == 0
+                or inp.params.num_queries == 0 or r * nchunks <= 1):
+            return None, None
+        from dmlp_tpu.ops import summaries as osum
+        if not osum.prune_enabled():
+            return None, None
+        ranges = []
+        for rr in range(r):
+            for t in range(nchunks):
+                lo = rr * shard_rows + t * chunk_rows
+                hi = min(lo + chunk_rows, (rr + 1) * shard_rows, n)
+                ranges.append((lo, max(hi, lo)))
+        with obs_span("sharded.prune_score", blocks=len(ranges)):
+            summ = osum.build_summaries(inp.data_attrs, ranges)
+            keep, stats = osum.prune_mask(inp.query_attrs, inp.ks, summ,
+                                          staging=self._staging)
+        return keep.reshape(r, nchunks), stats
+
+    def _solve_chunked_extract(self, inp: KNNInput, routed: bool = True,
+                               allow_prune: bool = False):
         """Chunked staging + per-chunk extract folds over the mesh.
 
         The r3 mesh engines staged the full padded dataset in ONE
@@ -524,21 +574,49 @@ class ShardedEngine:
             od, ol, oi = self._outlier_init_fn(r, qo_pad, ko)()
             ostep = self._outlier_fold_fn(ko, select_out)
 
+        # Pruned two-stage solve: each shard prunes locally before its
+        # fold (zero-filled piece + n_real = 0 via the live mask); a
+        # chunk every shard pruned is never staged or dispatched at
+        # all. ``None`` keep == dense scan, one compiled program either
+        # way (the mask is a data input, not a cache key).
+        keep_m, prune_stats = self._plan_prune_mesh(
+            inp, r, shard_rows, nchunks, chunk_rows, allow_prune)
+        lsh = NamedSharding(self.mesh, P(DATA_AXIS))
+        ones_live = jax.device_put(np.ones(r, np.int32), lsh)
+        n_disp = nchunks if keep_m is None \
+            else int(keep_m.any(axis=0).sum())
+        item = np.dtype(np_dtype).itemsize
+        scanned = 0
+        first = True
         src = np.ascontiguousarray(inp.data_attrs, np.float32)
         throttle = ChunkThrottle()
         mi = MeasuredIters(self, "sharded.chunk_fold",
                            (qloc, chunk_rows, na, k), kernel=impl)
         from dmlp_tpu.ops.pallas_fused import variant_for
         with obs_span("sharded.enqueue_chunked", chunks=nchunks,
-                      mesh=[r, c], kc=k, impl=impl,
+                      scheduled=n_disp, mesh=[r, c], kc=k, impl=impl,
                       variant=variant_for(impl, k, chunk_rows, qloc, na)):
             for t in range(nchunks):
+                live_col = None if keep_m is None else keep_m[:, t]
+                if live_col is not None and not live_col.any():
+                    continue     # every shard pruned this chunk
                 toff = t * chunk_rows
                 # Staging buffer directly in the wire dtype: slice
                 # assignment converts in place (one pass), instead of
                 # f32-zeros + a full astype copy per chunk.
                 a = np.zeros((r * chunk_rows, na), np_dtype)
                 for rr in range(r):
+                    if live_col is not None and not live_col[rr]:
+                        # Pruned piece: stays zero, folds dead. NOTE on
+                        # accounting: scanned_bytes counts CORPUS rows
+                        # read from host DRAM — a partially-pruned
+                        # chunk's device_put below still ships the full
+                        # zero-filled buffer over the link, so only
+                        # chunks EVERY shard pruned also save link
+                        # traffic on the mesh path (the single-chip and
+                        # serve paths save both; ops.summaries.note_scan
+                        # documents the metric's meaning).
+                        continue
                     lo = rr * shard_rows + toff
                     # Cap at the shard boundary too (see _chunk_fold_fn):
                     # the rows past it belong to — and are staged by —
@@ -547,23 +625,38 @@ class ShardedEngine:
                     if hi > lo:
                         a[rr * chunk_rows: rr * chunk_rows + (hi - lo)] = \
                             src[lo:hi]
+                        scanned += (hi - lo) * na * item
                 a_dev = jax.device_put(a, csh)
                 sc = jax.device_put(
                     np.asarray([n, toff, shard_rows], np.int32), rsh)
-                if t == 0:
+                lv = ones_live if live_col is None else jax.device_put(
+                    np.asarray(live_col, np.int32), lsh)
+                if first:
+                    first = False
                     obs_counters.record_dispatch(
-                        step, (cd, ci, a_dev, q_dev, sc), count=nchunks,
-                        site="sharded.chunk_fold")
-                cd, ci, its = step(cd, ci, a_dev, q_dev, sc)
+                        step, (cd, ci, a_dev, q_dev, sc, lv),
+                        count=n_disp, site="sharded.chunk_fold")
+                cd, ci, its = step(cd, ci, a_dev, q_dev, sc, lv)
                 mi.add(its)
                 if ostep is not None:
                     od, ol, oi = ostep(od, ol, oi, a_dev, qo_dev, lab_dev,
-                                       sc)
+                                       sc, lv)
                 throttle.tick(od if ostep is not None else cd)
                 # Watermark tick while the staged chunk is still
                 # referenced (no-op without a telemetry session).
                 telemetry.sample_memory_now()
         mi.done()
+        from dmlp_tpu.ops.summaries import note_scan
+        note_scan(self, scanned_bytes=scanned,
+                  dense_bytes=n * na * item,
+                  blocks_total=(prune_stats or {}).get(
+                      "blocks_total",
+                      sum(1 for rr in range(r) for t in range(nchunks)
+                          if min(rr * shard_rows + (t + 1) * chunk_rows,
+                                 (rr + 1) * shard_rows, n)
+                          > rr * shard_rows + t * chunk_rows)),
+                  blocks_pruned=(prune_stats or {}).get(
+                      "blocks_pruned", 0))
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         # Collective-traffic accounting from the shapes actually merged
@@ -597,7 +690,12 @@ class ShardedEngine:
         self.last_comms = []     # no stale traffic either
         self._pending_iters = []
         self.last_extract_impl = None
+        self.last_prune = None
         memwatch.note_engine_model(self, inp)
+        # candidates() feeds the multi-host per-shard contract path,
+        # whose consumers reason about PER-SHARD candidate horizons —
+        # global-k pruning would thin the per-shard lists, so this
+        # entry always scans densely.
         out = self._solve_chunked_extract(inp, routed=False)
         if out is not None:
             top, _ = out
@@ -668,7 +766,11 @@ class ShardedEngine:
         self.last_comms = []
         self._pending_iters = []
         self.last_extract_impl = None
-        out = self._solve_chunked_extract(inp)
+        self.last_prune = None
+        # Pruning rides the exact contract path only: the f64 rescore +
+        # boundary repair are the backstop the soundness margin leans on.
+        out = self._solve_chunked_extract(inp,
+                                          allow_prune=self.config.exact)
         if isinstance(out, list):
             return out
         if out is not None:
@@ -946,7 +1048,10 @@ class ShardedEngine:
         self.last_comms = []
         self._pending_iters = []
         self.last_extract_impl = None
+        self.last_prune = None
         memwatch.note_engine_model(self, inp)
+        # Device-full output IS the f32 device ordering (no repair
+        # backstop), so this benchmark path always scans densely.
         out = self._solve_chunked_extract(inp)
         if out is not None:
             from dmlp_tpu.engine.single import _device_epilogue

@@ -497,9 +497,48 @@ class SingleChipEngine:
         # extract paths queue when a cost probe is installed; flushed to
         # obs.counters after the solve fence (measured extraction term).
         self._pending_iters: list = []
+        # Pruned two-stage solve accounting (ops.summaries.note_scan):
+        # blocks_total/blocks_pruned/scanned_bytes/dense_bytes of the
+        # last solve — the bench A/B and the CLI metrics summary read
+        # it. None until a chunked driver runs.
+        self.last_prune = None
         # Analytic peak-HBM model of the last solve (obs.memwatch);
         # populated only while a telemetry session is active.
         self.last_mem_model = None
+
+    def _staging_itemsize(self) -> int:
+        return 2 if self._staging == "bfloat16" else 4
+
+    def _plan_prune(self, inp: KNNInput, nchunks: int, chunk_rows: int):
+        """Stage 0+1 of the pruned two-stage solve for a chunked
+        driver: (survivor chunk schedule, plan stats | None). Active
+        only on the resilience ladder's top ``prune`` rung (run()
+        enters it; candidates()/run_device_full stay dense — fast
+        ordering has no repair backstop), in exact mode, with the
+        ``DMLP_TPU_PRUNE`` kill switch on, and when there is more than
+        one block to choose between. The schedule preserves natural
+        chunk order, so ChunkThrottle backpressure and the affine-id
+        contract are untouched — pruned blocks are simply never
+        staged."""
+        n = inp.params.num_data
+        dense = list(range(nchunks))
+        if (nchunks <= 1 or n == 0 or inp.params.num_queries == 0
+                or self._degrade_rung != "prune"
+                or not self.config.exact):
+            return dense, None
+        from dmlp_tpu.ops import summaries as osum
+        if not osum.prune_enabled():
+            return dense, None
+        ranges = [(c * chunk_rows, min((c + 1) * chunk_rows, n))
+                  for c in range(nchunks)]
+        with obs_span("single.prune_score", blocks=nchunks):
+            summ = osum.build_summaries(inp.data_attrs, ranges)
+            keep, stats = osum.prune_mask(inp.query_attrs, inp.ks, summ,
+                                          staging=self._staging)
+        schedule = [c for c in dense if keep[c]]
+        if not schedule:       # belt: prune_mask guarantees a survivor
+            return dense, None
+        return schedule, stats
 
     def _prep(self, inp: KNNInput):
         cfg = self.config
@@ -544,6 +583,11 @@ class SingleChipEngine:
             out: TopK = _topk_blocks(d_attrs, d_labels, d_ids, q_blocks,
                                      **statics)
             sp.fence(out.dists)
+        from dmlp_tpu.ops.summaries import note_scan
+        dense = inp.params.num_data * inp.params.num_attrs \
+            * self._staging_itemsize()
+        note_scan(self, scanned_bytes=dense, dense_bytes=dense,
+                  blocks_total=1, blocks_pruned=0)
         return TopK(out.dists.reshape(qpad, -1), out.labels.reshape(qpad, -1),
                     out.ids.reshape(qpad, -1)), qpad
 
@@ -594,14 +638,20 @@ class SingleChipEngine:
                  for i in range(nqb)]
 
         # Stage chunks (async puts) and enqueue their folds immediately,
-        # under the sliding-window backpressure (ChunkThrottle).
+        # under the sliding-window backpressure (ChunkThrottle). The
+        # survivor schedule (pruned two-stage solve) composes here: a
+        # pruned chunk is never staged, so its bytes never cross the
+        # host->device link at all.
+        schedule, prune_stats = self._plan_prune(inp, nchunks, chunk_rows)
         carries = [init_topk(qsb, k) for _ in range(nqb)]
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
         throttle = ChunkThrottle()
+        scanned = 0
         statics = dict(k=k, select=select, use_pallas=cfg.use_pallas)
         with obs_span("single.enqueue_pipelined", select=select,
-                      chunks=nchunks, qblocks=nqb, k=k):
-            for c in range(nchunks):
+                      chunks=nchunks, scheduled=len(schedule),
+                      qblocks=nqb, k=k):
+            for c in schedule:
                 lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
                 a = np.zeros((chunk_rows, na), np.float32)
                 lab = np.full(chunk_rows, -1, np.int32)
@@ -611,11 +661,12 @@ class SingleChipEngine:
                     lab[:hi - lo] = inp.labels[lo:hi]
                     ids[:hi - lo] = np.arange(lo, hi, dtype=np.int32)
                 da = stage_put(a, self._staging)
+                scanned += max(hi - lo, 0) * na * self._staging_itemsize()
                 dl, di = jax.device_put(lab), jax.device_put(ids)
-                if c == 0:
+                if c == schedule[0]:
                     obs_counters.record_dispatch(
                         _chunk_fold, (carries[0], q_dev[0], da, dl, di),
-                        statics=statics, count=nchunks * nqb,
+                        statics=statics, count=len(schedule) * nqb,
                         site="single.chunk_fold")
                 for b in range(nqb):
                     carries[b] = _chunk_fold(carries[b], q_dev[b], da, dl,
@@ -626,6 +677,12 @@ class SingleChipEngine:
                 # would miss the staging window (no-op unless a
                 # telemetry session is active).
                 telemetry.sample_memory_now()
+        from dmlp_tpu.ops.summaries import note_scan
+        note_scan(self, scanned_bytes=scanned,
+                  dense_bytes=n * na * self._staging_itemsize(),
+                  blocks_total=nchunks,
+                  blocks_pruned=(prune_stats or {}).get(
+                      "blocks_pruned", 0))
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         if nqb == 1:
@@ -680,32 +737,36 @@ class SingleChipEngine:
         self._last_select = "extract"
         self.last_extract_impl = impl
 
+        schedule, prune_stats = self._plan_prune(inp, nchunks, chunk_rows)
+        live = [c for c in schedule if c * chunk_rows < n]
         q_attrs = np.zeros((qpad, na), np.float32)
         q_attrs[:nq] = inp.query_attrs
         q_dev = stage_put(q_attrs, self._staging)
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
         od = oi = None
+        scanned = 0
         mi = MeasuredIters(self, "single.extract_topk",
                            (qpad, chunk_rows, na, k), kernel=impl)
         throttle = ChunkThrottle()
         with obs_span("single.enqueue_extract", chunks=nchunks, kc=k,
-                      impl=impl,
+                      impl=impl, scheduled=len(live),
                       variant=pallas_fused.variant_for(
                           impl, k, chunk_rows, qpad, na)):
-            for c in range(nchunks):
+            for c in live:    # survivor schedule; pruned blocks are
+                # never staged — the beyond-HBM payoff is exactly that
+                # their bytes never leave host DRAM
                 lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
-                if lo >= n:
-                    break  # whole-block padding can leave an empty chunk
                 a = np.zeros((chunk_rows, na), np.float32)
                 if hi > lo:
                     a[:hi - lo] = src_attrs[lo:hi]
                 da = stage_put(a, self._staging)
-                if c == 0:
+                scanned += (hi - lo) * na * self._staging_itemsize()
+                if c == live[0]:
                     # Resolved via the analytic kernel model
                     # (obs.kernel_cost) — pallas_call has no XLA cost.
                     obs_counters.record_dispatch(
                         kern, (q_dev, da), statics=dict(kc=k),
-                        count=min(nchunks, -(-n // chunk_rows)),
+                        count=len(live),
                         site="single.extract_topk")
                 od, oi, _iters = kern(
                     q_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=k,
@@ -714,6 +775,12 @@ class SingleChipEngine:
                 throttle.tick(od)
                 telemetry.sample_memory_now()   # staging window live
         mi.done()
+        from dmlp_tpu.ops.summaries import note_scan
+        note_scan(self, scanned_bytes=scanned,
+                  dense_bytes=n * na * self._staging_itemsize(),
+                  blocks_total=min(nchunks, -(-n // chunk_rows)),
+                  blocks_pruned=(prune_stats or {}).get(
+                      "blocks_pruned", 0))
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         top = _extract_finalize(od, oi, jax.device_put(inp.labels), k=k)
@@ -913,6 +980,13 @@ class SingleChipEngine:
         from dmlp_tpu.obs import trace as obs_trace
         obs_trace.instant("single.multipass_sweep", passes=len(ods),
                           kcap=kcap, chunks=n_staged)
+        # The multipass plan keeps the dataset resident and re-sweeps
+        # it; every block stays competitive against floor-raised passes,
+        # so it scans densely by design (staged bytes counted once).
+        from dmlp_tpu.ops.summaries import note_scan
+        dense = n * na * self._staging_itemsize()
+        note_scan(self, scanned_bytes=dense, dense_bytes=dense,
+                  blocks_total=n_staged, blocks_pruned=0)
         top, valid = _mp_merge(jnp.concatenate(ods, axis=1),
                                jnp.concatenate(ois, axis=1),
                                jax.device_put(inp.labels), kcap=kcap)
@@ -935,6 +1009,7 @@ class SingleChipEngine:
         self.last_phase_ms = {}  # no stale phases if a path is skipped
         self._pending_iters = []
         self.last_extract_impl = None
+        self.last_prune = None   # no stale scan accounting either
         select = self.config.resolve_select(
             round_up(max(inp.params.num_data, 1), 8))
         if select == "sort":
@@ -1008,24 +1083,29 @@ class SingleChipEngine:
         labels_pad[:n] = inp.labels
         labels_dev = jax.device_put(labels_pad)
 
+        # The prune plan covers BOTH query sets (bulk and outliers ride
+        # the same per-query ks), so the shared staging sweep may only
+        # skip a chunk no query of either segment can need.
+        schedule, prune_stats = self._plan_prune(inp, nchunks, chunk_rows)
+        live_sched = [c for c in schedule if c * chunk_rows < n]
         carry_o = init_topk(qo_pad, ko)
         src_attrs = np.ascontiguousarray(inp.data_attrs, np.float32)
         od = oi = None
+        scanned = 0
         mi = MeasuredIters(self, "single.extract_bulk",
                            (qpad_b, chunk_rows, na, kb), kernel=impl)
         throttle = ChunkThrottle()
-        for c in range(nchunks):
+        for c in live_sched:
             lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
-            if lo >= n:
-                break
             a = np.zeros((chunk_rows, na), np.float32)
             if hi > lo:
                 a[:hi - lo] = src_attrs[lo:hi]
             da = stage_put(a, self._staging)
-            if c == 0:
+            scanned += (hi - lo) * na * self._staging_itemsize()
+            if c == live_sched[0]:
                 obs_counters.record_dispatch(
                     kern, (qb_dev, da), statics=dict(kc=kb),
-                    count=min(nchunks, -(-n // chunk_rows)),
+                    count=len(live_sched),
                     site="single.extract_bulk")
             od, oi, _iters = kern(
                 qb_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=kb,
@@ -1039,6 +1119,12 @@ class SingleChipEngine:
             throttle.tick(carry_o.dists)
             telemetry.sample_memory_now()   # staging window live
         mi.done()
+        from dmlp_tpu.ops.summaries import note_scan
+        note_scan(self, scanned_bytes=scanned,
+                  dense_bytes=n * na * self._staging_itemsize(),
+                  blocks_total=min(nchunks, -(-n // chunk_rows)),
+                  blocks_pruned=(prune_stats or {}).get(
+                      "blocks_pruned", 0))
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
 
         top_b = _extract_finalize(od, oi, jax.device_put(inp.labels),
@@ -1062,6 +1148,7 @@ class SingleChipEngine:
         self.last_mp_passes = 0
         self._pending_iters = []
         self.last_extract_impl = None
+        self.last_prune = None
         # Both routed and multipass paths dispatch the extraction
         # kernel; the "streaming" rung skips straight to _solve, whose
         # own gate lands on the chunk-fold driver.

@@ -46,7 +46,7 @@ from typing import Dict, Optional
 
 __all__ = ["extract_topk_cost", "extract_loop_cost", "fused_topk_cost",
            "two_pass_equivalent_cost", "fused_dist_segmin_cost",
-           "analytic_cost"]
+           "summaries_score_cost", "analytic_cost"]
 
 
 def _variant_resolver(kernel: str):
@@ -216,6 +216,27 @@ def fused_dist_segmin_cost(qb: int, b: int, a: int) -> Dict[str, float]:
     return {"flops": flops, "bytes_accessed": byts}
 
 
+def summaries_score_cost(qb: int, nblocks: int, a: int
+                         ) -> Dict[str, float]:
+    """Deterministic cost of one ``ops.summaries.score_blocks``
+    dispatch (the pruned two-stage solve's per-batch scoring pass over
+    the resident block summaries): per (query, block) the norm-band
+    bound (~6 ops), the box gap + farthest-corner reductions (~6*a),
+    and the threshold accumulation's sort/cumsum (~log2(B) per entry).
+    Bytes are the summaries + queries in, the (B,) mask out — the
+    whole point is that this is O(blocks * a), not O(corpus)."""
+    import math
+    logb = max(math.ceil(math.log2(max(nblocks, 2))), 1)
+    flops = (2.0 * qb * a                       # query norms
+             + qb * nblocks * (6.0 * a + 6.0)   # box + band bounds
+             + qb * nblocks * (logb + 4.0))     # sort/cumsum/threshold
+    byts = 4.0 * (qb * a                        # query panel
+                  + nblocks * (2.0 * a + 3.0)   # boxes + bands + counts
+                  + 3.0 * qb * nblocks          # lb/ub/order temps
+                  + nblocks)                    # survivor mask out
+    return {"flops": flops, "bytes_accessed": byts}
+
+
 def _extract_entry(specs, statics) -> Optional[Dict[str, float]]:
     try:
         import jax
@@ -249,6 +270,18 @@ def _segmin_entry(specs, statics) -> Optional[Dict[str, float]]:
     return fused_dist_segmin_cost(qb, b, a)
 
 
+def _score_entry(specs, statics) -> Optional[Dict[str, float]]:
+    del statics
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves(specs)
+        (qb, a) = leaves[0].shape          # q (qpad, a)
+        (nblocks,) = leaves[3].shape       # counts (B,)
+    except Exception:
+        return None
+    return summaries_score_cost(qb, nblocks, a)
+
+
 def analytic_cost(fn, specs, statics: Optional[dict] = None
                   ) -> Optional[Dict[str, float]]:
     """The registered analytic cost of one dispatch of ``fn`` at the
@@ -256,11 +289,12 @@ def analytic_cost(fn, specs, statics: Optional[dict] = None
     then falls through to XLA cost analysis). Never raises."""
     try:
         from dmlp_tpu.ops import pallas_distance, pallas_extract, \
-            pallas_fused
+            pallas_fused, summaries
         models = {
             id(pallas_extract.extract_topk): _extract_entry,
             id(pallas_fused.fused_topk): _fused_entry,
             id(pallas_distance.fused_dist_segmin): _segmin_entry,
+            id(summaries.score_blocks): _score_entry,
         }
         entry = models.get(id(fn))
         if entry is None:

@@ -275,6 +275,13 @@ def _runrecord_series_name(rec: RunRecord, key: str) -> str:
     Everything else keys ``{kind}:{tool}[/configN]/{metric}``."""
     cid = rec.config.get("config_id") if isinstance(rec.config, dict) \
         else None
+    if rec.kind == "prune":
+        # Pruned-vs-dense A/B records (bench --prune-ab, the prune
+        # smoke/capacity tools): one ``prune/`` family regardless of
+        # emitter so scanned-bytes and per-arm engine times stay
+        # round-comparable (gated by tools/perf_gate.py).
+        cfg_tag = f"/config{cid}" if cid is not None else ""
+        return f"prune{cfg_tag}/{key}"
     if rec.tool == "dmlp_tpu.bench" and cid is not None:
         return f"harness/config{cid}/{key}"
     if rec.kind == "telemetry":

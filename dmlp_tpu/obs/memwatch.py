@@ -283,7 +283,8 @@ def train_step_model(dims, batch: int, optimizer: str = "sgd",
 def serve_engine_model(capacity_rows: int, na: int,
                        staging: str = "float32", qpad: int = 0,
                        kcap: int = 0, extract_chunks: int = 0,
-                       chunk_rows: int = 0) -> Dict[str, Any]:
+                       chunk_rows: int = 0,
+                       summary_blocks: int = 0) -> Dict[str, Any]:
     """Peak resident device bytes for the serving layer's
     :class:`~dmlp_tpu.serve.engine.ResidentEngine`: the capacity-padded
     resident corpus (+ labels/ids mask arrays), the extract path's
@@ -299,6 +300,11 @@ def serve_engine_model(capacity_rows: int, na: int,
     }
     if extract_chunks:
         terms["extract_chunks"] = extract_chunks * chunk_rows * na * item
+    if summary_blocks:
+        # Device-resident block summaries of the pruned two-stage
+        # solve (ops.summaries.stage_summaries): two (B, A) f32 boxes,
+        # two (B,) f32 norm bands, one (B,) i32 count vector.
+        terms["resident_summaries"] = summary_blocks * (8 * na + 12)
     if qpad:
         terms["query_blocks"] = qpad * na * item
         terms["topk_carries"] = 2 * qpad * kcap * _TOPK_ITEMSIZE
@@ -342,7 +348,10 @@ def model_for_engine(engine, inp) -> Dict[str, Any]:
             engine.capacity_rows, p.num_attrs, staging=engine._staging,
             qpad=qpad, kcap=kcap,
             extract_chunks=(engine._ex_nchunks if engine._chunks else 0),
-            chunk_rows=engine._ex_chunk_rows)
+            chunk_rows=engine._ex_chunk_rows,
+            summary_blocks=(engine._ex_nchunks
+                            if getattr(engine, "_summ_dev", None)
+                            is not None else 0))
     if type(engine).__name__ == "SingleChipEngine":
         return single_engine_model(p.num_data, p.num_queries, p.num_attrs,
                                    kmax, config=engine.config,

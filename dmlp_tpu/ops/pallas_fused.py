@@ -134,13 +134,16 @@ def resolve_topk_kernel(qb: int, b: int, a: int, kc: int,
     tiles it (callers fall back to the streaming selects).
 
     Preference order: the fused megakernel when the kill switch allows
-    it, the engine's degradation rung is still "fused", and the fused
-    variant tiles the shape; else the tuned two-pass extraction kernel.
+    it, the engine's degradation rung is still at or above "fused"
+    (the top "prune" rung composes scan pruning WITH the fused
+    kernel), and the fused variant tiles the shape; else the tuned
+    two-pass extraction kernel.
     MUST be called OUTSIDE any jitted body (lint R203) and the returned
     label must key every compiled-program cache that bakes the choice
     in — the selection is part of the jit cache key by construction.
     """
-    if rung == "fused" and fused_enabled() and supports(qb, b, a, kc):
+    if rung in ("prune", "fused") and fused_enabled() \
+            and supports(qb, b, a, kc):
         return fused_topk, "fused"
     if extract_supports(qb, b, a, kc):
         return extract_topk, "extract"

@@ -193,6 +193,15 @@ class ResidentEngine(SingleChipEngine):
         self._block_hits = np.zeros(max(self._ex_nchunks, 1), np.int64)
         self._pending_gate: Optional[Tuple] = None
         self.last_gated_fraction: Optional[float] = None
+        # Pruned two-stage solve state (ops.summaries): host f64 block
+        # summaries at extract-chunk granularity + their device-resident
+        # conservative f32 copies, built with the chunks and rebuilt
+        # per touched block on ingest (a stale summary is silent
+        # unsoundness — the one failure mode the repair cannot catch).
+        self._summ = None
+        self._summ_dev = None
+        self.summary_rebuilds = 0
+        self.last_prune_fraction: Optional[float] = None
         reg = telemetry.registry()
         reg.gauge("serve.corpus_rows").set(n)
         reg.gauge("serve.capacity_rows").set(self.capacity_rows)
@@ -306,6 +315,59 @@ class ResidentEngine(SingleChipEngine):
                     a[:hi - lo] = self._host_attrs[lo:hi]
                 chunks.append(stage_put(a, self._staging))
         self._chunks = chunks
+        self._build_summaries()
+
+    # -- resident block summaries (pruned two-stage solve, stage 0) -----------
+
+    def _chunk_span(self, c: int) -> Tuple[int, int]:
+        cr = self._ex_chunk_rows
+        return c * cr, min(c * cr + cr, self.n_real)
+
+    def _build_summaries(self) -> None:
+        """Stage 0 at ingest granularity: one summary block per
+        resident extract chunk, host f64 + device-resident f32 copies
+        (tiny: O(blocks * a))."""
+        from dmlp_tpu.ops import summaries as osum
+        if not self._extract_ok or self._ex_nchunks <= 1 \
+                or not osum.prune_enabled():
+            return
+        with obs_span("serve.summary_build", blocks=self._ex_nchunks):
+            self._summ = osum.build_summaries(
+                self._host_attrs,
+                [self._chunk_span(c) for c in range(self._ex_nchunks)])
+            self._stage_summaries()
+        telemetry.registry().gauge("prune.summary_blocks").set(
+            self._ex_nchunks)
+
+    def _stage_summaries(self) -> None:
+        from dmlp_tpu.engine.finalize import (EPS_CANCEL_COEF,
+                                              EPS_REL_BF16, EPS_REL_F32)
+        from dmlp_tpu.ops import summaries as osum
+        dev = osum.stage_summaries(self._summ)
+        rel = EPS_REL_BF16 if self._staging == "bfloat16" else EPS_REL_F32
+        dev["eps_rel"] = jax.device_put(np.float32(rel))
+        dev["eps_cancel"] = jax.device_put(
+            np.float32(EPS_CANCEL_COEF * (self.num_attrs + 2)))
+        self._summ_dev = dev
+
+    def _rebuild_summary_blocks(self, blocks) -> None:
+        """Ingest invalidation: rebuild EXACTLY the touched blocks'
+        summaries from their current host rows, then restage the
+        device copies — the incremental counterpart of
+        _restage_chunk, counted so tests can assert the invalidation
+        actually happened."""
+        from dmlp_tpu.ops import summaries as osum
+        if self._summ is None:
+            return
+        blocks = list(blocks)
+        for c in blocks:
+            lo, hi = self._chunk_span(c)
+            osum.update_block(self._summ, c, self._host_attrs[lo:hi],
+                              lo_hi=(lo, hi))
+        self._stage_summaries()
+        self.summary_rebuilds += len(blocks)
+        telemetry.registry().counter("prune.summary_rebuilds").inc(
+            len(blocks))
 
     def _restage_chunk(self, c: int) -> None:
         sdt = np_staging_dtype(self._staging)
@@ -368,8 +430,13 @@ class ResidentEngine(SingleChipEngine):
                 self._d_ids, jax.device_put(blk_ids), s)
             if self._chunks is not None:
                 cr = self._ex_chunk_rows
-                for c in range(start // cr, -(-new_n // cr)):
+                touched = range(start // cr, -(-new_n // cr))
+                for c in touched:
                     self._restage_chunk(c)
+                # The summaries of exactly the touched blocks must
+                # rebuild with the rows — a stale summary could keep a
+                # block pruned whose NEW rows belong in a top-k.
+                self._rebuild_summary_blocks(touched)
         reg = telemetry.registry()
         reg.counter("serve.ingested_rows").inc(m)
         reg.gauge("serve.corpus_rows").set(new_n)
@@ -414,13 +481,58 @@ class ResidentEngine(SingleChipEngine):
             out: TopK = entry.stream(self._d_attrs, self._d_labels,
                                      self._d_ids, q_blocks)
             sp.fence(out.dists)
+        # The AOT streaming program scans the whole resident buffer by
+        # construction (static shapes): a dense scan, recorded as such.
+        from dmlp_tpu.ops.summaries import note_scan
+        dense = self.n_real * na * self._staging_itemsize()
+        note_scan(self, scanned_bytes=dense, dense_bytes=dense,
+                  blocks_total=1, blocks_pruned=0)
         return TopK(out.dists.reshape(entry.qpad, -1),
                     out.labels.reshape(entry.qpad, -1),
                     out.ids.reshape(entry.qpad, -1)), entry.qpad
 
+    def _prune_survivors(self, inp: KNNInput, entry: _Bucket, q_dev):
+        """Stage 1 per micro-batch: score the RESIDENT summaries on
+        device (ops.summaries.score_blocks — compiled once per bucket
+        shape) and read back the tiny (blocks,) survivor mask. Active
+        on the ladder's top ``prune`` rung in exact mode only; returns
+        (mask, stats) or (None, None) for a dense fold."""
+        from dmlp_tpu.obs import counters as obs_counters
+        from dmlp_tpu.ops import summaries as osum
+        if (self._summ_dev is None or self._degrade_rung != "prune"
+                or not self.config.exact or not osum.prune_enabled()):
+            return None, None
+        nq = inp.params.num_queries
+        ks = np.ones(entry.qpad, np.int32)
+        ks[:nq] = inp.ks
+        qvalid = np.zeros(entry.qpad, bool)
+        qvalid[:nq] = True
+        sd = self._summ_dev
+        args = (q_dev, jax.device_put(qvalid), jax.device_put(ks),
+                sd["counts"], sd["nmin"], sd["nmax"], sd["lo"],
+                sd["hi"], sd["dn_max"], sd["eps_rel"], sd["eps_cancel"])
+        with obs_span("serve.prune_score", blocks=self._ex_nchunks,
+                      qpad=entry.qpad):
+            obs_counters.record_dispatch(osum.score_blocks, args,
+                                         site="serve.prune_score")
+            mask = osum.score_blocks(*args)
+            # Deliberate tiny fence: the (blocks,) mask decides WHICH
+            # resident chunks the folds dispatch over, so the host must
+            # read it before enqueueing them — O(blocks) bytes, priced
+            # by the analytic score model, nothing like a result fetch.
+            keep = np.asarray(
+                jax.device_get(mask))  # check: allow-host-sync
+        total = int(np.count_nonzero(
+            self._summ.counts[:self._ex_nchunks] > 0))
+        pruned = total - int(np.count_nonzero(keep))
+        if not keep.any():
+            return None, None   # belt: score_blocks keeps >= 1 block
+        return keep, {"blocks_total": total, "blocks_pruned": pruned}
+
     def _solve_resident_extract(self, inp: KNNInput, entry: _Bucket
                                 ) -> Optional[Tuple[TopK, int]]:
         from dmlp_tpu.ops import pallas_fused
+        from dmlp_tpu.ops.summaries import note_scan
         kern, impl = pallas_fused.resolve_topk_kernel(
             entry.qpad, self._ex_chunk_rows, self.num_attrs, entry.kcap,
             rung=self._degrade_rung)
@@ -433,15 +545,22 @@ class ResidentEngine(SingleChipEngine):
         q_dev = stage_put(q, self._staging)
         cr = self._ex_chunk_rows
         order = self._chunk_order()
+        survivors, prune_stats = self._prune_survivors(inp, entry, q_dev)
+        if survivors is not None:
+            # Survivor ∩ hot-first order: the winner-histogram sort
+            # stays the fold order, pruned chunks simply drop out.
+            order = [c for c in order if survivors[c]]
         od = oi = None
         gz = None
         ntiles = 0
+        scanned = 0
+        item = self._staging_itemsize()
         throttle = ChunkThrottle()
         self._last_select = "extract"
         self.last_extract_impl = impl
         with obs_span("serve.solve_extract", qpad=entry.qpad,
                       kcap=entry.kcap, impl=impl,
-                      carry=self.gate_carry):
+                      carry=self.gate_carry, scheduled=len(order)):
             for c in order:
                 lo = c * cr
                 nr = min(self.n_real - lo, cr)
@@ -450,12 +569,24 @@ class ResidentEngine(SingleChipEngine):
                 od, oi, iters = kern(q_dev, self._chunks[c], od, oi,
                                      n_real=nr, id_base=lo, kc=entry.kcap,
                                      interpret=self._interpret)
+                scanned += nr * na * item
                 z = jnp.sum(iters == 0)
                 gz = z if gz is None else gz + z
                 ntiles += int(np.prod(iters.shape))
                 throttle.tick(od)
                 telemetry.sample_memory_now()
+        if od is None:
+            # Every scheduled chunk was empty (cannot happen with a
+            # sound mask, the belt above): fall back to a dense fold.
+            return None
         self._pending_gate = (gz, ntiles)
+        note_scan(self, scanned_bytes=scanned,
+                  dense_bytes=self.n_real * na * item,
+                  blocks_total=(prune_stats or {}).get(
+                      "blocks_total", -(-self.n_real // cr)),
+                  blocks_pruned=(prune_stats or {}).get(
+                      "blocks_pruned", 0))
+        self.last_prune_fraction = self.last_prune["pruned_fraction"]
         top = _extract_finalize(od, oi, self._d_labels, k=entry.kcap)
         return top, entry.qpad
 
@@ -475,6 +606,7 @@ class ResidentEngine(SingleChipEngine):
         self.last_phase_ms = {}
         self._pending_iters = []
         self.last_extract_impl = None
+        self.last_prune = None
         if inp.params.num_data != self.n_real:
             raise ValueError(
                 f"resident solve got a foreign corpus "
@@ -595,6 +727,11 @@ class ResidentEngine(SingleChipEngine):
         # different states. list() of a dict is a single atomic read
         # under the GIL; the engine stays single-writer.
         entries = list(self._buckets.values())
+        # Same single-read discipline for last_prune: the batcher
+        # thread resets it to None at the start of every solve, so an
+        # isinstance check followed by a second attribute read could
+        # straddle that write and dict(None)-crash a stats handler.
+        lp = self.last_prune
         return {
             "buckets": sorted(e.key for e in entries),
             "paths": {e.key: e.path for e in entries},
@@ -606,6 +743,10 @@ class ResidentEngine(SingleChipEngine):
             "gate_carry": self.gate_carry,
             "last_gated_fraction": self.last_gated_fraction,
             "extract_chunks": self._ex_nchunks if self._chunks else 0,
+            "summary_blocks": self._ex_nchunks if self._summ else 0,
+            "summary_rebuilds": self.summary_rebuilds,
+            "last_prune_fraction": self.last_prune_fraction,
+            "last_prune": dict(lp) if isinstance(lp, dict) else None,
         }
 
 

@@ -47,8 +47,10 @@ CACHE_SCHEMA = 2
 
 #: the schema-2 envelope family; per-entry keys carry the concrete kernel
 _KERNEL_FAMILY = "pallas_topk"
-#: legal per-entry kernel namespaces
-_KERNELS = ("extract_topk", "fused_topk")
+#: legal per-entry kernel namespaces ("prune_score" is the pruned
+#: two-stage solve's block-scoring pass — ops.summaries resolves its
+#: block-chunk tiling through the same contract)
+_KERNELS = ("extract_topk", "fused_topk", "prune_score")
 #: the schema-1 envelope value (extract-only caches; lenient load)
 _KERNEL_V1 = "extract_topk"
 

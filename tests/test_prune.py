@@ -1,0 +1,321 @@
+"""Pruned two-stage solve (ops.summaries): BYTE-IDENTITY is the contract.
+
+Bound soundness at the unit level (every block bound dominates the f64
+distances it claims to), then the adversarial engine-level contract:
+corpora with duplicate rows astride summary-block boundaries and blocks
+sitting exactly at the pruning threshold, solved with pruning on and
+off × the fused gate on and off, at the single / sharded / ring / serve
+levels — every arm byte-identical to the float64 golden model. Plus
+non-vacuity (a norm-banded corpus must actually prune), the kill
+switch, the ladder's ``prune`` rung, and the serving ingest
+summary-invalidation fix (stale summaries are silent unsoundness).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.engine.single import SingleChipEngine
+from dmlp_tpu.golden.reference import knn_golden
+from dmlp_tpu.io.grammar import KNNInput, Params
+from dmlp_tpu.io.report import format_results
+from dmlp_tpu.ops import summaries as osum
+
+
+def _case(seed: int, n=2048, nq=12, na=5, kmax=16, block=256,
+          banded=False, dup_boundaries=False):
+    """Fuzz corpus: optional norm bands per block, optional duplicate
+    rows straddling every summary-block boundary (the tie-adversarial
+    case: a pruned block may not swallow one copy of a duplicate whose
+    other copy survives — ids break the tie)."""
+    rng = np.random.default_rng(seed)
+    data = rng.uniform(0, 5, (n, na))
+    if banded:
+        for b in range(n // block):
+            data[b * block:(b + 1) * block] += 40.0 * b
+    if dup_boundaries:
+        for b in range(1, n // block):
+            edge = b * block
+            data[edge] = data[edge - 1]          # exact duplicate pair
+            if edge + 1 < n:
+                data[edge + 1] = data[edge - 2]  # crossed duplicate
+    labels = rng.integers(0, 6, n).astype(np.int32)
+    ks = rng.integers(1, kmax + 1, nq).astype(np.int32)
+    q = rng.uniform(0, 5, (nq, na))
+    if banded:
+        # one query per far band too, so pruning decisions interact
+        q[-1] = data[n - block // 2] + rng.uniform(-0.5, 0.5, na)
+    return KNNInput(Params(n, nq, na), labels, data, ks, q)
+
+
+# -- unit: bound soundness ----------------------------------------------------
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_block_bounds_dominate_true_distances(seed):
+    inp = _case(seed, n=1024, nq=8, block=128, banded=(seed % 2 == 0))
+    ranges = [(b * 128, (b + 1) * 128) for b in range(8)]
+    summ = osum.build_summaries(inp.data_attrs, ranges)
+    lb, ub = osum.block_bounds(inp.query_attrs, summ)
+    d = np.square(inp.query_attrs[:, None, :]
+                  - inp.data_attrs[None, :, :]).sum(-1)     # (Q, N) f64
+    for b, (lo, hi) in enumerate(ranges):
+        blockd = d[:, lo:hi]
+        assert (lb[:, b] <= blockd.min(axis=1) + 1e-9).all()
+        assert (ub[:, b] >= blockd.max(axis=1) - 1e-9).all()
+
+
+def test_kth_thresholds_dominate_true_kth():
+    inp = _case(4, n=1024, nq=16, block=128, banded=True)
+    summ = osum.build_summaries(
+        inp.data_attrs, [(b * 128, (b + 1) * 128) for b in range(8)])
+    _, ub = osum.block_bounds(inp.query_attrs, summ)
+    thr = osum.kth_thresholds(ub, summ.counts, inp.ks)
+    d = np.sort(np.square(inp.query_attrs[:, None, :]
+                          - inp.data_attrs[None, :, :]).sum(-1), axis=1)
+    true_kth = d[np.arange(len(inp.ks)), inp.ks - 1]
+    assert (thr >= true_kth - 1e-9).all()
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13, 14])
+def test_prune_mask_never_drops_a_topk_block(seed):
+    inp = _case(seed, n=2048, nq=10, block=256, banded=(seed % 2 == 0),
+                dup_boundaries=True)
+    summ = osum.build_summaries(
+        inp.data_attrs, [(b * 256, (b + 1) * 256) for b in range(8)])
+    keep, stats = osum.prune_mask(inp.query_attrs, inp.ks, summ)
+    d = np.square(inp.query_attrs[:, None, :]
+                  - inp.data_attrs[None, :, :]).sum(-1)
+    for qi, k in enumerate(np.asarray(inp.ks)):
+        topk_rows = np.argsort(d[qi], kind="stable")[:k]
+        blocks = set(int(r) // 256 for r in topk_rows)
+        assert all(keep[b] for b in blocks), (seed, qi, stats)
+
+
+def test_empty_and_overflow_blocks():
+    # corpus smaller than k: threshold must be +inf, nothing pruned
+    inp = _case(5, n=64, nq=4, block=32, kmax=16)
+    inp = KNNInput(inp.params, inp.labels, inp.data_attrs,
+                   np.full(4, 64, np.int32), inp.query_attrs)
+    summ = osum.build_summaries(inp.data_attrs, [(0, 32), (32, 64),
+                                                 (64, 96)])
+    assert summ.counts[2] == 0
+    keep, _ = osum.prune_mask(inp.query_attrs, inp.ks, summ)
+    assert keep[0] and keep[1] and not keep[2]  # empty never survives
+
+
+# -- engine level: the byte-identity fuzz ------------------------------------
+
+@pytest.mark.parametrize("seed,banded", [(21, True), (22, False),
+                                         (23, True)])
+def test_single_streaming_prune_on_off_byte_identical(monkeypatch, seed,
+                                                      banded):
+    inp = _case(seed, banded=banded, dup_boundaries=True)
+    gold = format_results(knn_golden(inp))
+    for prune in ("1", "0"):
+        monkeypatch.setenv("DMLP_TPU_PRUNE", prune)
+        eng = SingleChipEngine(EngineConfig(select="topk",
+                                            data_block=256))
+        assert format_results(eng.run(inp)) == gold, (seed, prune)
+        if prune == "0":
+            assert eng.last_prune["blocks_pruned"] == 0
+        assert eng.last_prune["scanned_bytes"] <= \
+            eng.last_prune["dense_bytes"]
+    monkeypatch.delenv("DMLP_TPU_PRUNE")
+
+
+def test_single_extract_prune_fused_matrix(monkeypatch):
+    """The flagship path: 2 extract chunks, far band in chunk 2, prune
+    on/off x fused gate on/off — all four arms byte-identical to
+    golden, and the pruned arms must actually skip the far chunk."""
+    rng = np.random.default_rng(31)
+    n, nq, na = 14000, 6, 3
+    data = rng.uniform(0, 1, (n, na))
+    data[12800:] += 200.0
+    # exact-duplicate pair INSIDE the to-be-pruned block (a tie group
+    # the pruned scan must drop or keep as a unit); a duplicate pair
+    # ACROSS the band boundary legitimately un-prunes — one copy would
+    # be a near row inside the far block, or a far outlier inflating
+    # the near block's box and hence the threshold (that arm is the
+    # streaming fuzz's job, where byte identity is still asserted).
+    data[12900] = data[12901]
+    inp = KNNInput(Params(n, nq, na),
+                   rng.integers(0, 4, n).astype(np.int32), data,
+                   rng.integers(1, 6, nq).astype(np.int32),
+                   rng.uniform(0, 1, (nq, na)))
+    gold = format_results(knn_golden(inp))
+    for fused in ("1", "0"):
+        for prune in ("1", "0"):
+            monkeypatch.setenv("DMLP_TPU_FUSED", fused)
+            monkeypatch.setenv("DMLP_TPU_PRUNE", prune)
+            eng = SingleChipEngine(EngineConfig(
+                select="extract", use_pallas=True, data_block=12800))
+            assert format_results(eng.run(inp)) == gold, (fused, prune)
+            want = 1 if prune == "1" else 0
+            assert eng.last_prune["blocks_pruned"] == want
+    monkeypatch.delenv("DMLP_TPU_FUSED")
+    monkeypatch.delenv("DMLP_TPU_PRUNE")
+
+
+def test_nonvacuity_banded_corpus_prunes_most_blocks():
+    """ISSUE acceptance: on a norm-banded corpus the pruned fraction
+    must exceed 0.5 — near-band-0 queries can only need the first
+    band(s)."""
+    rng = np.random.default_rng(41)
+    n, nq, na, block = 4096, 8, 6, 256
+    data = rng.uniform(0, 2, (n, na))
+    for b in range(n // block):
+        data[b * block:(b + 1) * block] += 30.0 * b
+    inp = KNNInput(Params(n, nq, na),
+                   rng.integers(0, 5, n).astype(np.int32), data,
+                   rng.integers(1, 9, nq).astype(np.int32),
+                   rng.uniform(0, 2, (nq, na)))
+    eng = SingleChipEngine(EngineConfig(select="topk", data_block=block))
+    res = format_results(eng.run(inp))
+    assert res == format_results(knn_golden(inp))
+    assert eng.last_prune["pruned_fraction"] > 0.5, eng.last_prune
+    assert eng.last_prune["scanned_bytes"] < \
+        0.5 * eng.last_prune["dense_bytes"]
+
+
+def test_dense_paths_stay_dense(monkeypatch):
+    """candidates() and run_device_full have no f64-repair backstop on
+    their output orderings — they must never take the pruned path even
+    with the switch on."""
+    monkeypatch.setenv("DMLP_TPU_PRUNE", "1")
+    inp = _case(51, banded=True)
+    eng = SingleChipEngine(EngineConfig(select="topk", data_block=256))
+    eng.candidates(inp)
+    assert eng.last_prune["blocks_pruned"] == 0
+    eng.run_device_full(inp)
+    assert eng.last_prune["blocks_pruned"] == 0
+    monkeypatch.delenv("DMLP_TPU_PRUNE")
+
+
+def test_prune_rung_allows_fused_kernel():
+    from dmlp_tpu.ops import pallas_fused
+    _, impl = pallas_fused.resolve_topk_kernel(128, 12800, 8, 32,
+                                               rung="prune")
+    assert impl == "fused"
+
+
+def test_oom_degrades_prune_to_fused_byte_identical():
+    """The ladder's new top rung: a staging OOM on the pruned solve
+    steps prune -> fused (dense) and the answer is unchanged."""
+    from dmlp_tpu.resilience import inject, stats
+    from dmlp_tpu.resilience.inject import FaultEntry, FaultSchedule
+
+    inp = _case(61, banded=True)
+    gold = format_results(knn_golden(inp))
+    stats.reset()
+    inject.install(FaultSchedule(
+        [FaultEntry("single.stage_put", "oom", times=1)]))
+    try:
+        eng = SingleChipEngine(EngineConfig(select="topk",
+                                            data_block=256))
+        got = format_results(eng.run(inp))
+    finally:
+        inject.uninstall()
+    assert got == gold
+    assert eng.last_degrade_rung == "fused"
+    assert "prune->fused" in stats.snapshot()["degradations"]
+    assert eng.last_prune["blocks_pruned"] == 0   # the fused rung is dense
+
+
+# -- mesh engines -------------------------------------------------------------
+
+def _mesh_case(seed=71):
+    rng = np.random.default_rng(seed)
+    n, nq, na = 25600, 8, 3
+    data = rng.uniform(0, 1, (n, na))
+    data[12800:] += 200.0
+    data[12900] = data[12901]   # duplicate tie pair inside the far shard
+    return KNNInput(Params(n, nq, na),
+                    rng.integers(0, 4, n).astype(np.int32), data,
+                    rng.integers(1, 6, nq).astype(np.int32),
+                    rng.uniform(0, 1, (nq, na)))
+
+
+@pytest.mark.parametrize("mode", ["sharded", "ring"])
+def test_mesh_prune_on_off_byte_identical(monkeypatch, mode):
+    """Each shard prunes locally before its fold: shard 1's far band
+    folds dead (live mask), and the merged result is byte-identical to
+    golden with pruning on and off."""
+    from dmlp_tpu.engine.ring import RingEngine
+    from dmlp_tpu.engine.sharded import ShardedEngine
+
+    cls = RingEngine if mode == "ring" else ShardedEngine
+    inp = _mesh_case()
+    gold = format_results(knn_golden(inp))
+    for prune in ("1", "0"):
+        monkeypatch.setenv("DMLP_TPU_PRUNE", prune)
+        eng = cls(EngineConfig(mode=mode, select="extract",
+                               use_pallas=True, mesh_shape=(4, 2),
+                               data_block=12800))
+        assert format_results(eng.run(inp)) == gold, (mode, prune)
+        want = 1 if prune == "1" else 0
+        assert eng.last_prune["blocks_pruned"] == want, eng.last_prune
+    monkeypatch.delenv("DMLP_TPU_PRUNE")
+
+
+# -- serving ------------------------------------------------------------------
+
+def _serve_fixture():
+    rng = np.random.default_rng(81)
+    n, na = 13000, 3
+    data = rng.uniform(0, 1, (n, na))
+    data[12800:] += 300.0          # block 1's 200 rows: far
+    corpus = KNNInput(Params(n, 0, na),
+                      rng.integers(0, 4, n).astype(np.int32), data,
+                      np.zeros(0, np.int32), np.zeros((0, na)))
+    from dmlp_tpu.serve.engine import ResidentEngine
+    eng = ResidentEngine(corpus, EngineConfig(
+        select="extract", use_pallas=True, data_block=12800))
+    q = rng.uniform(0, 1, (6, na))
+    ks = np.array([3, 1, 5, 2, 4, 3], np.int32)
+    return eng, q, ks, rng
+
+
+def _serve_golden(eng, q, ks):
+    nrows = eng.n_real
+    inp = KNNInput(Params(nrows, len(ks), eng.num_attrs),
+                   eng._host_labels[:nrows].copy(),
+                   eng._host_attrs[:nrows].copy(),
+                   np.asarray(ks, np.int32), np.asarray(q, np.float64))
+    return format_results(knn_golden(inp))
+
+
+def test_serve_resident_prune_golden_identity():
+    eng, q, ks, _ = _serve_fixture()
+    got = format_results(eng.solve_batch(q, ks))
+    assert got == _serve_golden(eng, q, ks)
+    assert eng.last_prune["blocks_pruned"] == 1, eng.last_prune
+    assert eng.bucket_stats()["last_prune_fraction"] == 0.5
+
+
+def test_serve_ingest_rebuilds_summaries_and_unprunes():
+    """The fix-with-test satellite: ingested rows that belong in a
+    previously-pruned block must rebuild exactly that block's summary
+    (counter asserted) and un-prune it — with a stale summary the new
+    rows would silently vanish from every top-k."""
+    eng, q, ks, rng = _serve_fixture()
+    assert format_results(eng.solve_batch(q, ks)) == \
+        _serve_golden(eng, q, ks)
+    assert eng.last_prune["blocks_pruned"] == 1
+    r0 = eng.summary_rebuilds
+    new_rows = rng.uniform(0, 1, (20, eng.num_attrs))  # near the queries
+    eng.ingest(rng.integers(0, 4, 20).astype(np.int32), new_rows)
+    assert eng.summary_rebuilds == r0 + 1        # exactly block 1
+    got = format_results(eng.solve_batch(q, ks))
+    assert got == _serve_golden(eng, q, ks)      # ingested rows found
+    assert eng.last_prune["blocks_pruned"] == 0  # block 1 un-pruned
+
+
+def test_serve_prune_kill_switch(monkeypatch):
+    monkeypatch.setenv("DMLP_TPU_PRUNE", "0")
+    eng, q, ks, _ = _serve_fixture()
+    assert format_results(eng.solve_batch(q, ks)) == \
+        _serve_golden(eng, q, ks)
+    assert eng.last_prune["blocks_pruned"] == 0
+    monkeypatch.delenv("DMLP_TPU_PRUNE")

@@ -327,16 +327,17 @@ def test_ladder_steps_down_per_oom():
 
     def solve(inp):
         seen.append(eng._degrade_rung)
-        if len(seen) < 4:
+        if len(seen) < 5:
             raise SimulatedResourceExhausted("RESOURCE_EXHAUSTED")
         return "answer"
 
     assert degrade.run_ladder(eng, None, solve) == "answer"
-    assert seen == ["fused", "tuned", "heuristic", "streaming"]
+    assert seen == ["prune", "fused", "tuned", "heuristic", "streaming"]
     assert eng.last_degrade_rung == "streaming"
     assert eng._degrade_rung == "fused"       # restored after the run
     assert stats.snapshot()["degradations"] == \
-        ["fused->tuned", "tuned->heuristic", "heuristic->streaming"]
+        ["prune->fused", "fused->tuned", "tuned->heuristic",
+         "heuristic->streaming"]
 
 
 def test_ladder_propagates_non_oom():
@@ -357,15 +358,15 @@ def test_ladder_heuristic_rung_suppresses_tune_cache():
 
     def solve(inp):
         seen.append(tune_cache.lookup_variant(32, 1024, a=8))
-        if len(seen) <= 2:
+        if len(seen) <= 3:
             raise SimulatedResourceExhausted("RESOURCE_EXHAUSTED")
         return "ok"
 
     degrade.run_ladder(eng, None, solve)
-    # The fused and tuned rungs may consult the cache (None here:
+    # The prune/fused/tuned rungs may consult the cache (None here:
     # conftest pins a nonexistent path); the heuristic rung must not
     # even try.
-    assert len(seen) == 3 and seen[2] is None
+    assert len(seen) == 4 and seen[3] is None
 
 
 # -- engine-level byte-identical recovery ------------------------------------
@@ -392,10 +393,11 @@ def test_engine_recovers_transients_byte_identical():
     assert snap["retries"] >= 3 and snap["faults_injected"] == 3
 
 
-@pytest.mark.parametrize("times,rung", [(1, "tuned"),
-                                        (2, "heuristic"),
-                                        (3, "streaming"),
-                                        (4, "host")])
+@pytest.mark.parametrize("times,rung", [(1, "fused"),
+                                        (2, "tuned"),
+                                        (3, "heuristic"),
+                                        (4, "streaming"),
+                                        (5, "host")])
 def test_engine_ladder_byte_identical(times, rung):
     inp = _small_input()
     golden = format_results(knn_golden(inp))

@@ -198,12 +198,18 @@ def test_extract_gate_carry_ab_byte_identical_and_golden():
     assert outs[True][2] == format_results(knn_golden(inp))
 
 
-def test_gate_carry_hot_block_ordering_gates_cold_blocks():
+def test_gate_carry_hot_block_ordering_gates_cold_blocks(monkeypatch):
     """Non-vacuous warm-up proof on a norm-banded corpus: the winners
     live in the LAST chunk, so natural order folds them last (cold
     blocks never gate — they fold before any tight threshold exists),
     while carry-over folds the hot chunk first and the far bands gate
-    out. Results stay byte-identical either way."""
+    out. Results stay byte-identical either way.
+
+    Pruning is pinned OFF here: the two-stage prune (ops.summaries)
+    would skip the far bands before the MXU gate ever sees them —
+    exactly the layering this test isolates the gate FROM (the pruned
+    composition has its own coverage in tests/test_prune.py)."""
+    monkeypatch.setenv("DMLP_TPU_PRUNE", "0")
     rng = np.random.default_rng(55)
     n, na = 38400, 4                       # 3 extract chunks of 12800
     base = rng.uniform(-1.0, 1.0, (n, na))

@@ -109,9 +109,9 @@ def make_schedule(kind: str, seed: int) -> dict:
             {"site": "io.parse", "kind": "corrupt"},
         ]
     elif kind == "oom":
-        # times = how deep the ladder steps from the fused top rung:
-        # 1 -> tuned two-pass kernel, 2 -> heuristic variant,
-        # 3 -> streaming fold.
+        # times = how deep the ladder steps from the prune top rung:
+        # 1 -> dense fused, 2 -> tuned two-pass kernel,
+        # 3 -> heuristic variant.
         faults = [{"site": "single.stage_put", "kind": "oom",
                    "times": rng.randint(1, 3)}]
     else:

@@ -45,7 +45,7 @@ from dmlp_tpu.obs.ledger import build_ledger, series_deltas  # noqa: E402
 #: "{kind}:" prefixes catch RunRecord series with no legacy ancestor.
 GATED_PREFIXES = ("harness/", "bench:", "bench/", "trainbench/", "serve/",
                   "train:", "engine:", "roofline:", "capacity:",
-                  "telemetry/")
+                  "telemetry/", "prune/")
 
 
 def gated(series: str, better: str = "lower") -> bool:
