@@ -7,7 +7,7 @@ CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
 .PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
 	perf-gate check lint chaos-smoke telemetry-smoke serve-smoke \
-	race-smoke prune-smoke serve-bench clean
+	race-smoke prune-smoke fleet-smoke serve-bench fleet-bench clean
 
 all: native
 
@@ -17,7 +17,8 @@ native/_fastparse.so: native/fastparse.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
 test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint \
-	chaos-smoke telemetry-smoke serve-smoke race-smoke prune-smoke
+	chaos-smoke telemetry-smoke serve-smoke race-smoke prune-smoke \
+	fleet-smoke
 	python -m pytest tests/ -q
 
 # Static analysis + runtime-sanitizer smoke (README "Static analysis &
@@ -213,6 +214,43 @@ prune-smoke:
 	JAX_PLATFORMS=cpu python tools/prune_smoke.py --out outputs/prune
 	JAX_PLATFORMS=cpu BENCH_OUT=outputs/prune/CAPACITY_PRUNE_SMOKE.json \
 	  python tools/capacity_beyond_hbm.py --cpu-smoke > /dev/null
+
+# Serving-fleet smoke (README "Fleet serving"): a REAL fleet on CPU —
+# a plain resident replica + a mesh-resident replica (--mesh 2x1,
+# per-shard resident chunk buffers, allgather merge as the micro-batch
+# epilogue) behind the `python -m dmlp_tpu.fleet` router. Eight
+# proofs: both replicas warm and announce; the committed paced trace
+# (inputs/serve_trace2.jsonl) replayed closed-loop THROUGH the router
+# is byte-identical to the golden oracle with traffic actually fanned;
+# compile counters stay flat on both replicas; paced OPEN-LOOP replay
+# at two offered-load multipliers lands p50/p95/p99 in gated
+# fleet/<level>/ ledger series; a wide-k request (k past the kernel's
+# single-pass window) serves through the multipass driver against the
+# resident chunks, golden and compile-flat; one ingest through the
+# router fans out to every replica and the grown-corpus replay stays
+# golden with zero new compiles; the router's /metrics merges both
+# replicas' scrapes into one valid OpenMetrics exposition (counters
+# summed, histograms bucket-wise, per-replica gauges) and the serve
+# trace validator rejects non-monotonic t_ms; one in-band drain
+# propagates router -> replicas with every process exiting 0 and no
+# flight dumps.
+fleet-smoke:
+	mkdir -p outputs/fleet
+	rm -f outputs/fleet/FLEET_SMOKE.jsonl
+	JAX_PLATFORMS=cpu python tools/fleet_smoke.py --out outputs/fleet \
+	  --record outputs/fleet/FLEET_SMOKE.jsonl
+
+# Fleet SLO bench (not in `make test`; emits the FLEET_rNN ledger
+# rounds): 2 replicas (one mesh-resident) + router, the paced trace
+# replayed OPEN-LOOP at a sweep of offered-load multipliers, 3 reps
+# per level — the p99-under-offered-load curve, gated by perf_gate.
+# On a TPU host drop JAX_PLATFORMS and add
+# --replica-flags "--pallas --select extract".
+fleet-bench:
+	mkdir -p outputs/fleet_bench
+	JAX_PLATFORMS=cpu python tools/fleet_bench.py \
+	  --metrics outputs/fleet_bench/FLEET_BENCH.jsonl \
+	  --out outputs/fleet_bench --replicas 2 --mesh-replica --reps 3
 
 # Serving throughput bench (not in `make test`; emits the SERVE_rNN
 # ledger rounds): replay inputs/serve_trace1.jsonl against the daemon

@@ -41,9 +41,10 @@ from dmlp_tpu.check.findings import Finding
 #: path fragments that make a module a hot path for this family —
 #: serve/ joined when the resident engine's gate-stats readback turned
 #: out to carry a dead allowlist (the serving solve loop is exactly as
-#: sync-sensitive as the batch engines)
+#: sync-sensitive as the batch engines); fleet/ joined with the
+#: mesh-resident serving engine (its fold loop is the same hot path)
 HOT_DIRS = ("dmlp_tpu/engine/", "dmlp_tpu/ops/", "dmlp_tpu/parallel/",
-            "dmlp_tpu/serve/")
+            "dmlp_tpu/serve/", "dmlp_tpu/fleet/")
 
 #: call prefixes whose results live on device (taint seeds)
 DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "lax.")

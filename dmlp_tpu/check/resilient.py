@@ -8,7 +8,8 @@ can eat an :class:`InjectedTransientError` or a real RESOURCE_EXHAUSTED
 before the retry/ladder machinery ever classifies it, turning a
 recoverable fault into a silently wrong or silently degraded run.
 
-Scope: modules inside ``dmlp_tpu/resilience/`` and ``dmlp_tpu/serve/``
+Scope: modules inside ``dmlp_tpu/resilience/``, ``dmlp_tpu/serve/``,
+and ``dmlp_tpu/fleet/``
 (the serving daemon's per-request error paths swallow by design and
 must say so), plus any module that imports ``dmlp_tpu.resilience``
 (i.e. paths actually wrapped by the layer). A handler is compliant
@@ -67,7 +68,8 @@ def _reraises(handler: ast.ExceptHandler) -> bool:
 
 def in_resilient_scope(mod: ModuleInfo) -> bool:
     rel = mod.relpath.replace("\\", "/")
-    if rel.startswith(("dmlp_tpu/resilience/", "dmlp_tpu/serve/")):
+    if rel.startswith(("dmlp_tpu/resilience/", "dmlp_tpu/serve/",
+                       "dmlp_tpu/fleet/")):
         return True
     return any(src.startswith("dmlp_tpu.resilience")
                for src in mod.imports.values())

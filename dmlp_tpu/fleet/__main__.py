@@ -1,0 +1,141 @@
+"""``python -m dmlp_tpu.fleet`` — the fleet front-end router CLI.
+
+Usage::
+
+    python -m dmlp_tpu.fleet --replicas H:P,H:P[,...]
+        [--scrape-ports Q,Q,...] [--port 0] [--ready-file PATH]
+        [--telemetry-port PORT] [--record FILE]
+        [--health-interval-s S] [--request-timeout-s S]
+
+Fans the daemon wire protocol (queries load-balanced with bounded
+retry-on-replica-failure, ingest fanned out to every replica, stats
+aggregated) across the given daemon replicas; ``--telemetry-port``
+serves the merged fleet OpenMetrics view (per-replica scrapes +
+router counters). Prints ``dmlp_tpu.fleet: ready port=P replicas=N``
+on stderr (and writes ``--ready-file``), then routes until SIGTERM or
+an in-band ``drain`` op — which propagates the drain to every replica,
+finishes in-flight relays, appends the final fleet RunRecord, and
+exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+
+def _parse_replicas(spec: str) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            host, port = part.rsplit(":", 1)
+            out.append((host or "127.0.0.1", int(port)))
+        except ValueError:
+            raise SystemExit(
+                f"--replicas entries are HOST:PORT, got {part!r}")
+    if not out:
+        raise SystemExit("--replicas lists no endpoints")
+    return out
+
+
+def _parse_ports(spec: Optional[str], n: int) -> List[Optional[int]]:
+    if not spec:
+        return [None] * n
+    out: List[Optional[int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        out.append(int(part) if part and part != "-" else None)
+    if len(out) != n:
+        raise SystemExit("--scrape-ports needs one entry per replica "
+                         "('-' for none)")
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="dmlp_tpu.fleet",
+                                description=__doc__)
+    p.add_argument("--replicas", required=True, metavar="H:P,H:P",
+                   help="daemon replica endpoints to fan across")
+    p.add_argument("--scrape-ports", default=None, metavar="Q,Q",
+                   help="per-replica telemetry ports for the "
+                        "aggregated fleet scrape ('-' skips one)")
+    p.add_argument("--port", type=int, default=0,
+                   help="front-end TCP port (0 = ephemeral)")
+    p.add_argument("--ready-file", metavar="PATH", default=None)
+    p.add_argument("--telemetry-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve the merged fleet OpenMetrics view here "
+                        "(0 = ephemeral, announced in the ready file)")
+    p.add_argument("--record", metavar="FILE", default=None,
+                   help="append the final fleet-router RunRecord here")
+    p.add_argument("--health-interval-s", type=float, default=1.0)
+    p.add_argument("--request-timeout-s", type=float, default=600.0)
+    args = p.parse_args(argv)
+
+    # Idempotent backstop (the real install runs in fleet/__init__,
+    # before any serving lock exists).
+    from dmlp_tpu.check import racecheck
+    racecheck.install_from_env()
+
+    from dmlp_tpu.fleet.router import FleetRouter
+
+    replicas = _parse_replicas(args.replicas)
+    scrape_ports = _parse_ports(args.scrape_ports, len(replicas))
+    router = FleetRouter(replicas, scrape_ports=scrape_ports,
+                         port=args.port,
+                         health_interval_s=args.health_interval_s,
+                         request_timeout_s=args.request_timeout_s,
+                         telemetry_port=args.telemetry_port)
+    try:
+        signal.signal(signal.SIGTERM,
+                      lambda s, f: router.request_drain())
+    except ValueError:
+        pass   # not the main thread (embedders): drain op only
+    router.start()
+    sys.stderr.write(f"dmlp_tpu.fleet: ready port={router.port} "
+                     f"replicas={len(replicas)}\n")
+    sys.stderr.flush()
+    if args.ready_file:
+        doc = {"port": router.port, "pid": os.getpid(),
+               "replicas": [r.name for r in router.replicas],
+               "telemetry_port": getattr(router, "telemetry_port",
+                                         None)}
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, args.ready_file)
+    router.run_until_drained()
+    if args.record:
+        from dmlp_tpu.obs.run import RunRecord, current_device
+        stats = router.stats()
+        metrics = {
+            "healthy_replicas": stats["healthy_replicas"],
+            "requests_total": sum(stats["requests"].values()),
+            "retries_total": sum(stats["retries"].values()),
+            "rejected_total": sum(stats["rejected"].values()),
+        }
+        lat = stats.get("request_latency_ms")
+        if lat:
+            metrics["request_latency_p50_ms"] = lat["p50"]
+            metrics["request_latency_p99_ms"] = lat["p99"]
+            metrics["request_count"] = lat["count"]
+        RunRecord(kind="fleet", tool="dmlp_tpu.fleet",
+                  config={"level": "router",
+                          "replicas": len(replicas),
+                          "mode": "closed_loop"},
+                  metrics=metrics,
+                  device=current_device()).append_jsonl(args.record)
+    racecheck.write_report_if_requested()
+    sys.stderr.write("dmlp_tpu.fleet: drained clean\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""Open-loop SLO harness: p99 under OFFERED load, as a gated series.
+
+Closed-loop replay (serve.client.replay) measures throughput at the
+pace the daemon sets — useful, but it cannot say "at 2× today's load,
+p99 is still X ms", because a closed-loop client slows down exactly
+when the server does. This module drives
+:func:`dmlp_tpu.serve.client.replay_open_loop` over a sweep of speed
+multipliers of a paced trace and emits one ledger-gated RunRecord per
+level (kind "fleet" -> ``fleet/<level>/<metric>`` series, gated by
+``tools/perf_gate.py``): requests fire on the trace's schedule whether
+or not earlier ones completed, so daemon-side queueing shows up in the
+latency quantiles instead of silently stretching the experiment.
+
+``reps >= 3`` gives each level's quantiles a real noise band in the
+ledger (obs.ledger qualifies A/B comparisons on raw trial lists).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dmlp_tpu.obs.run import RunRecord, current_device
+from dmlp_tpu.serve import client as sc
+
+
+def offered_qps(requests: List[Dict[str, Any]],
+                speed: float = 1.0) -> Optional[float]:
+    """The load a paced replay OFFERS: total queries over the trace's
+    t_ms span (compressed by ``speed``), independent of how fast the
+    daemon answers. None for an unpaced trace."""
+    ts = [float(r.get("t_ms", 0)) for r in requests]
+    span_s = (max(ts) / 1e3 / max(speed, 1e-9)) if ts else 0.0
+    queries = sum(int(r["nq"]) for r in requests)
+    if span_s <= 0:
+        return None
+    return round(queries / span_s, 3)
+
+
+def run_level(port: int, header: Dict[str, Any],
+              requests: List[Dict[str, Any]], speed: float,
+              reps: int = 1) -> Dict[str, Any]:
+    """``reps`` open-loop replays at one speed multiplier -> metrics:
+    per-rep p50/p95/p99/max client latency (measured from the
+    SCHEDULED fire time — queue delay included), dispatch lag, error
+    and rejection counts, achieved vs offered qps. Scalar metrics are
+    the median across reps; ``*_reps`` carry the raw per-rep lists so
+    the ledger can qualify noise bands."""
+    per_rep: Dict[str, List[float]] = {
+        "p50_ms": [], "p95_ms": [], "p99_ms": [], "max_ms": [],
+        "lag_p95_ms": [], "achieved_qps": []}
+    errors = 0
+    rejected = 0
+    total = 0
+    for _rep in range(max(reps, 1)):
+        res = sc.replay_open_loop(port, header, requests, speed=speed)
+        total += len(res)
+        ok = [r for r in res if r.get("ok")]
+        errors += sum(1 for r in res
+                      if not r.get("ok")
+                      and not str(r.get("error", "")).startswith(
+                          "rejected"))
+        rejected += sum(1 for r in res
+                        if not r.get("ok")
+                        and str(r.get("error", "")).startswith(
+                            "rejected"))
+        lat = np.asarray([r["client_ms"] for r in ok], np.float64)
+        lag = np.asarray([r.get("lag_ms", 0.0) for r in res],
+                         np.float64)
+        if lat.size:
+            per_rep["p50_ms"].append(float(np.percentile(lat, 50)))
+            per_rep["p95_ms"].append(float(np.percentile(lat, 95)))
+            per_rep["p99_ms"].append(float(np.percentile(lat, 99)))
+            per_rep["max_ms"].append(float(lat.max()))
+        if lag.size:
+            per_rep["lag_p95_ms"].append(float(np.percentile(lag, 95)))
+        # Achieved = completed queries over the wall span actually
+        # taken (first scheduled fire to last completion).
+        if ok:
+            span_ms = max(float(r.get("t_ms", 0)) / max(speed, 1e-9)
+                          + q["client_ms"]
+                          for r, q in zip(requests, res) if q.get("ok"))
+            done_q = sum(int(r["nq"]) for r, q in zip(requests, res)
+                         if q.get("ok"))
+            if span_ms > 0:
+                per_rep["achieved_qps"].append(
+                    round(done_q / (span_ms / 1e3), 3))
+    metrics: Dict[str, Any] = {
+        "requests": total, "errors": errors, "rejected": rejected,
+    }
+    for key, vals in per_rep.items():
+        if not vals:
+            continue
+        metrics[key] = round(float(np.median(vals)), 3)
+        if len(vals) > 1:
+            metrics[f"{key}_reps"] = [round(v, 3) for v in vals]
+    return metrics
+
+
+def level_tag(speed: float) -> str:
+    s = f"{speed:g}".replace(".", "p")
+    return f"x{s}"
+
+
+def run_levels(port: int, header: Dict[str, Any],
+               requests: List[Dict[str, Any]],
+               speeds: Sequence[float], reps: int = 1,
+               replicas: int = 1, trace: str = "",
+               tool: str = "dmlp_tpu.fleet.loadgen"
+               ) -> List[RunRecord]:
+    """The p99-vs-offered-load curve: one RunRecord per speed level,
+    slowest level first (a warm daemon sees rising load, like
+    production). Each record's config pins the level tag the ledger
+    keys the series by (``fleet/x2/p99_ms``), the offered qps, and the
+    fleet topology."""
+    device = current_device()
+    out: List[RunRecord] = []
+    for speed in sorted(speeds):
+        metrics = run_level(port, header, requests, speed, reps=reps)
+        oq = offered_qps(requests, speed)
+        if oq is not None:
+            metrics["offered_qps"] = oq
+        out.append(RunRecord(
+            kind="fleet", tool=tool,
+            config={"level": level_tag(speed), "speed": speed,
+                    "replicas": replicas, "trace": trace,
+                    "mode": "open_loop",
+                    "requests_per_rep": len(requests), "reps": reps},
+            metrics=metrics, device=device))
+    return out

@@ -1,0 +1,647 @@
+"""Mesh-resident serving engine: the sharded corpus held resident.
+
+:class:`MeshResidentEngine` is the fleet's corpus-scale pillar — the
+serving counterpart of the batch mesh engines the way
+:class:`~dmlp_tpu.serve.engine.ResidentEngine` is the serving
+counterpart of the single-chip engine. It differs from a per-request
+:class:`~dmlp_tpu.engine.sharded.ShardedEngine` solve in exactly the
+ways a persistent multi-chip server needs:
+
+- **Per-shard resident chunk buffers.** The corpus is staged ONCE at
+  construction into the chunk layout the mesh chunk-fold programs
+  consume: chunk ``t`` is one ``(R * chunk_rows, A)`` device array
+  sharded ``P("data", None)`` holding every shard's ``t``-th piece,
+  padded to a power-of-two capacity. Global row ids stay the affine
+  ``rr * shard_rows + toff + j`` the fold programs derive on device
+  from the ``[n, toff, shard_rows]`` scalar — ``n`` is DATA, so the
+  corpus can grow without recompiling any solve program.
+- **The merge collective as the micro-batch epilogue.** Every
+  coalesced micro-batch runs the per-chunk fold over the resident
+  buffers and then the engines' existing allgather/ring candidate
+  merge (``_chunk_merge_fn``) — followed by the unchanged host
+  float64 finalize + boundary-hazard repair, so every served response
+  is byte-identical to the solo solve over the same corpus AND the
+  golden oracle.
+- **Resident per-(shard, chunk) summaries.** The pruned two-stage
+  solve's block summaries (PR 13) are built once over the shard-local
+  chunk ranges and kept resident; each micro-batch scores them
+  (host-side — the summaries are O(blocks * a)) into per-chunk live
+  masks, so chunks every shard pruned are never dispatched at all.
+  Ingest rebuilds exactly the touched blocks' summaries.
+- **Shard-routed ingest.** Appended rows land at their global row
+  positions — i.e. in the owning shard's span of the touched chunk
+  buffers — via a full restage of exactly those fixed-shape chunk
+  arrays (data inputs, never shapes: zero solve recompilation,
+  asserted by the compile counter like the single-chip resident
+  engine).
+
+Configs whose plan does not select the extraction kernel fall back to
+a resident MONOLITHIC layout: the full capacity-padded
+``(R * shard_rows, A)`` dataset + label/id arrays staged once, solved
+by the engines' merged ``_fn`` program (the allgather/ring merge runs
+inside it). Both layouts share the one global-row-id contract.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.engine.finalize import (boundary_overflow, finalize_host,
+                                      repair_boundary_overflow, staging_eps)
+from dmlp_tpu.engine.sharded import ShardedEngine, _np_staging_dtype
+from dmlp_tpu.engine.single import (_BF16_AUTO_K_CAP, ChunkThrottle,
+                                    MeasuredIters, flush_measured_iters,
+                                    plan_chunks, resilient_get, resolve_kcap,
+                                    round_up)
+from dmlp_tpu.io.grammar import KNNInput, Params
+from dmlp_tpu.io.report import QueryResult
+from dmlp_tpu.obs import counters as obs_counters
+from dmlp_tpu.obs import memwatch, telemetry
+from dmlp_tpu.obs.comms import engine_comms
+from dmlp_tpu.obs.trace import span as obs_span
+from dmlp_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS, make_mesh
+from dmlp_tpu.serve.engine import (CapacityError, ResidentServingCore,
+                                   k_bucket, query_bucket)
+from dmlp_tpu.tune.cache import shape_bucket
+
+
+class _MeshBucket:
+    """One (qpad, k-bucket) shape bucket of the mesh-resident engine:
+    the resolved candidate width and the chosen path ("extract" folds
+    the resident chunks, "stream" runs the monolithic merged program)."""
+
+    __slots__ = ("qpad", "kb", "kcap", "path", "qloc")
+
+    def __init__(self, qpad: int, kb: int, kcap: int, path: str,
+                 qloc: int):
+        self.qpad, self.kb, self.kcap = qpad, kb, kcap
+        self.path = path
+        self.qloc = qloc
+
+    @property
+    def key(self) -> str:
+        return f"q{self.qpad}k{self.kb}"
+
+
+class MeshResidentEngine(ResidentServingCore, ShardedEngine):
+    """Compile-once mesh-resident engine for the serving daemon.
+
+    Drop-in for :class:`~dmlp_tpu.serve.engine.ResidentEngine` behind
+    the daemon's batcher/admission surface (``solve_batch``/``ingest``/
+    ``warmup``/``bucket_plan``/``bucket_stats``); ``mesh_shape`` (or an
+    explicit ``mesh``) picks the 2D grid, ``merge`` the candidate-merge
+    collective ("allgather" | "ring").
+    """
+
+    def __init__(self, corpus: KNNInput, config: EngineConfig = None,
+                 mesh=None, mesh_shape: Optional[Tuple[int, int]] = None,
+                 capacity: Optional[int] = None,
+                 merge: str = "allgather"):
+        if merge not in ("allgather", "ring"):
+            raise ValueError(f"unknown merge strategy {merge!r}")
+        cfg = config or EngineConfig(mode="sharded")
+        if mesh is None:
+            shape = mesh_shape or cfg.mesh_shape
+            if shape is not None:
+                # An explicit shape needs only shape-many devices — a
+                # replica pinned to 2 of a host's 8 virtual devices is
+                # the normal fleet deployment, not an error.
+                import jax as _jax
+                r0, c0 = shape
+                mesh = make_mesh(shape,
+                                 devices=_jax.devices()[:r0 * c0])
+            else:
+                mesh = make_mesh(None)
+        super().__init__(cfg, mesh)
+        self._merge_strategy = merge
+        r, c = self.mesh.devices.shape
+        n = corpus.params.num_data
+        na = corpus.params.num_attrs
+        if n < 1:
+            raise ValueError("resident corpus must have at least one row")
+        cap = capacity or shape_bucket(n)
+        if cap < n:
+            raise ValueError(f"capacity {cap} < corpus rows {n}")
+        self.num_attrs = na
+        self.gate_carry = False        # mesh path: natural fold order
+        self.last_gated_fraction = None
+
+        # -- per-shard chunk plan at CAPACITY shape (fixed for life) ---------
+        self._extract_ok = (cfg.use_pallas and cfg.resolve_select(
+            round_up(max(-(-cap // r), 1), 8)) == "extract")
+        granule = cfg.resolve_granule("extract") if self._extract_ok else 8
+        shard_rows, nchunks, chunk_rows = plan_chunks(
+            max(-(-cap // r), 1), granule, cfg.data_block)
+        self._shard_rows = shard_rows
+        self._nchunks = nchunks
+        self._chunk_rows = chunk_rows       # per-shard rows per chunk
+        self.capacity_rows = r * shard_rows
+        if self._extract_ok:
+            from dmlp_tpu.ops.pallas_distance import native_pallas_backend
+            self._interpret = not native_pallas_backend()
+        else:
+            self._interpret = True
+
+        # -- host originals (the float64 finalize rescore reads these) -------
+        self._host_attrs = np.zeros((self.capacity_rows, na), np.float64)
+        self._host_attrs[:n] = corpus.data_attrs
+        self._host_labels = np.full(self.capacity_rows, -1, np.int32)
+        self._host_labels[:n] = corpus.labels
+        self.n_real = n
+        # Corpus max squared norm for the boundary-repair eps — cached
+        # (an O(n*a) host pass per micro-batch would sit in every
+        # request's tail latency at corpus scale), updated
+        # incrementally on the append-only ingest.
+        self._dn_max_cache: Optional[float] = None
+
+        # -- resident device state -------------------------------------------
+        self._csh = NamedSharding(self.mesh, P(DATA_AXIS, None))
+        self._lsh = NamedSharding(self.mesh, P(DATA_AXIS))
+        self._qsh = NamedSharding(self.mesh, P(QUERY_AXIS, None))
+        self._rsh = NamedSharding(self.mesh, P())
+        self._lab_dev = jax.device_put(
+            np.ascontiguousarray(self._host_labels), self._rsh)
+        self._ones_live = jax.device_put(np.ones(r, np.int32), self._lsh)
+        self._chunks: Optional[List] = None
+        self._sc_dev: Optional[List] = None
+        self._mono = None              # (attrs, labels, ids) when staged
+        if self._extract_ok:
+            self._stage_chunks()
+        else:
+            self._ensure_monolithic()
+
+        # -- resident per-(shard, chunk) summaries ---------------------------
+        self._summ = None
+        self.summary_rebuilds = 0
+        self.last_prune_fraction = None
+        if self._chunks is not None:
+            self._build_summaries()
+
+        # -- bucket registry + compile bookkeeping ---------------------------
+        self._buckets: Dict[Tuple[int, int], _MeshBucket] = {}
+        self.compile_count = 0
+        self.cold_start_compile_ms: Optional[float] = None
+        self.bucket_compile_ms: Dict[str, float] = {}
+        reg = telemetry.registry()
+        reg.gauge("serve.corpus_rows").set(n)
+        reg.gauge("serve.capacity_rows").set(self.capacity_rows)
+        reg.gauge("serve.mesh_shards").set(r)
+
+    # -- resident staging -----------------------------------------------------
+
+    def _block_span(self, rr: int, t: int) -> Tuple[int, int]:
+        """Global row range of shard ``rr``'s piece of chunk ``t`` —
+        the ONE derivation shared by staging, summaries, and ingest
+        routing (mirrors the fold programs' on-device ``_chunk_span``)."""
+        lo = rr * self._shard_rows + t * self._chunk_rows
+        hi = min(lo + self._chunk_rows, (rr + 1) * self._shard_rows,
+                 self.n_real)
+        return lo, max(hi, lo)
+
+    def _chunk_host(self, t: int) -> np.ndarray:
+        """Chunk ``t``'s (R * chunk_rows, A) staging buffer from the
+        current host rows (each shard's span in its slot, pad zeroed)."""
+        r, _ = self.mesh.devices.shape
+        cr = self._chunk_rows
+        sdt = _np_staging_dtype(self._staging)
+        a = np.zeros((r * cr, self.num_attrs), sdt)
+        for rr in range(r):
+            lo, hi = self._block_span(rr, t)
+            if hi > lo:
+                a[rr * cr: rr * cr + (hi - lo)] = self._host_attrs[lo:hi]
+        return a
+
+    def _stage_chunks(self) -> None:
+        with obs_span("fleet.stage_resident", chunks=self._nchunks,
+                      mesh=list(self.mesh.devices.shape)):
+            self._chunks = [jax.device_put(self._chunk_host(t), self._csh)
+                            for t in range(self._nchunks)]
+        self._refresh_scalars()
+
+    def _refresh_scalars(self) -> None:
+        """The per-chunk ``[n, toff, shard_rows]`` fold scalars; rebuilt
+        on ingest (``n`` is the only moving part — a data input, so the
+        fold programs never recompile)."""
+        self._sc_dev = [
+            jax.device_put(np.asarray(
+                [self.n_real, t * self._chunk_rows, self._shard_rows],
+                np.int32), self._rsh)
+            for t in range(self._nchunks)]
+
+    def _ensure_monolithic(self) -> None:
+        """The streaming paths' resident layout: full capacity-padded
+        (attrs, labels, ids) staged once, sharded over "data"."""
+        if self._mono is not None:
+            return
+        sdt = _np_staging_dtype(self._staging)
+        attrs = np.zeros((self.capacity_rows, self.num_attrs), sdt)
+        attrs[:self.n_real] = self._host_attrs[:self.n_real]
+        ids = np.full(self.capacity_rows, -1, np.int32)
+        ids[:self.n_real] = np.arange(self.n_real, dtype=np.int32)
+        with obs_span("fleet.stage_monolithic", rows=self.capacity_rows):
+            self._mono = (
+                jax.device_put(attrs, self._csh),
+                jax.device_put(self._host_labels, self._lsh),
+                jax.device_put(ids, self._lsh))
+
+    # -- resident summaries (pruned two-stage solve, stage 0) -----------------
+
+    def _block_ranges(self) -> List[Tuple[int, int]]:
+        r, _ = self.mesh.devices.shape
+        return [self._block_span(rr, t)
+                for rr in range(r) for t in range(self._nchunks)]
+
+    def _build_summaries(self) -> None:
+        from dmlp_tpu.ops import summaries as osum
+        r, _ = self.mesh.devices.shape
+        if r * self._nchunks <= 1 or not osum.prune_enabled():
+            return
+        with obs_span("fleet.summary_build", blocks=r * self._nchunks):
+            self._summ = osum.build_summaries(self._host_attrs,
+                                              self._block_ranges())
+        telemetry.registry().gauge("prune.summary_blocks").set(
+            r * self._nchunks)
+
+    def _rebuild_summary_blocks(self, blocks) -> None:
+        """Ingest invalidation: rebuild exactly the touched (shard,
+        chunk) blocks' summaries from their current host rows — a stale
+        summary could keep a block pruned whose NEW rows belong in a
+        top-k (the one failure mode the repair cannot catch)."""
+        from dmlp_tpu.ops import summaries as osum
+        if self._summ is None:
+            return
+        blocks = list(blocks)
+        for rr, t in blocks:
+            lo, hi = self._block_span(rr, t)
+            b = rr * self._nchunks + t
+            osum.update_block(self._summ, b, self._host_attrs[lo:hi],
+                              lo_hi=(lo, hi))
+        self.summary_rebuilds += len(blocks)
+        telemetry.registry().counter("prune.summary_rebuilds").inc(
+            len(blocks))
+
+    def _prune_live(self, inp: KNNInput):
+        """Per-micro-batch stage 1: score the RESIDENT summaries (host
+        f64 — they are tiny) into an (R, T) live mask + stats, or
+        (None, None) for a dense fold. Sound per ops.summaries: a
+        pruned block provably contributes nothing below the staging-eps
+        margin, and the exact stage is unchanged."""
+        from dmlp_tpu.ops import summaries as osum
+        if (self._summ is None or not self.config.exact
+                or not osum.prune_enabled()
+                or inp.params.num_queries == 0):
+            return None, None
+        r, _ = self.mesh.devices.shape
+        with obs_span("fleet.prune_score", blocks=r * self._nchunks):
+            keep, stats = osum.prune_mask(inp.query_attrs, inp.ks,
+                                          self._summ,
+                                          staging=self._staging)
+        self.last_prune_fraction = stats["pruned_fraction"]
+        return keep.reshape(r, self._nchunks), stats
+
+    # -- shape buckets --------------------------------------------------------
+
+    @property
+    def query_granule(self) -> int:
+        if self._extract_ok:
+            from dmlp_tpu.ops.pallas_extract import QUERY_TILE
+            return QUERY_TILE
+        return 8
+
+    @property
+    def max_k(self) -> int:
+        cap = self.capacity_rows
+        if self._staging == "bfloat16":
+            cap = min(cap, _BF16_AUTO_K_CAP)
+        return cap
+
+    def bucket_shape(self, nq: int, kmax: int) -> Tuple[int, int]:
+        _r, c = self.mesh.devices.shape
+        qloc = query_bucket(max(-(-max(nq, 1) // c), 1),
+                            self.query_granule)
+        return (c * qloc, k_bucket(kmax))
+
+    def bucket_plan(self, nq: int, kmax: int) -> Tuple[int, int, int]:
+        """(qpad, k-bucket, kcap) — the ONE candidate-width derivation
+        admission pricing and the memwatch model share with the solve."""
+        qpad, kb = self.bucket_shape(nq, kmax)
+        kcap = resolve_kcap(self.config, kb, "extract",
+                            self.capacity_rows, staging=self._staging)
+        return qpad, kb, kcap
+
+    def _build_bucket(self, qpad: int, kb: int) -> _MeshBucket:
+        _r, c = self.mesh.devices.shape
+        qloc = qpad // c
+        kcap = resolve_kcap(self.config, kb, "extract",
+                            self.capacity_rows, staging=self._staging)
+        path = "stream"
+        if self._extract_ok and kcap <= 512:
+            from dmlp_tpu.ops import pallas_fused
+            kern, _ = pallas_fused.resolve_topk_kernel(
+                qloc, self._chunk_rows, self.num_attrs, kcap)
+            if kern is not None:
+                path = "extract"
+        if path == "stream":
+            self._ensure_monolithic()
+        return _MeshBucket(qpad, kb, kcap, path, qloc)
+
+    # -- resident solves ------------------------------------------------------
+
+    def _batch_input(self, query_attrs: np.ndarray,
+                     ks: np.ndarray) -> KNNInput:
+        nq = len(ks)
+        return KNNInput(
+            Params(self.n_real, nq, self.num_attrs),
+            self._host_labels[:self.n_real],
+            self._host_attrs[:self.n_real],
+            np.asarray(ks, np.int32),
+            np.asarray(query_attrs, np.float64))
+
+    def _stage_queries(self, inp: KNNInput, qpad: int):
+        nq = inp.params.num_queries
+        q = np.zeros((qpad, self.num_attrs), np.float32)
+        q[:nq] = inp.query_attrs
+        np_dtype = self._np_dtype()
+        return jax.device_put(q.astype(np_dtype, copy=False), self._qsh)
+
+    def _solve_resident_chunks(self, inp: KNNInput, entry: _MeshBucket):
+        """The mesh-resident hot path: fold the resident chunk buffers
+        (pruned chunks dropped per the live masks) and merge across the
+        data axis — the batch engines' chunked driver minus every
+        staging transfer."""
+        from dmlp_tpu.ops.summaries import note_scan
+        r, c = self.mesh.devices.shape
+        k, cr = entry.kcap, self._chunk_rows
+        impl = self._extract_impl("extract", entry.qloc, cr,
+                                  self.num_attrs, k)
+        q_dev = self._stage_queries(inp, entry.qpad)
+        keep_m, prune_stats = self._prune_live(inp)
+        cd, ci = self._chunk_init_fn(r, entry.qpad, k)()
+        step = self._chunk_fold_fn(k, self._interpret, impl)
+        item = np.dtype(self._np_dtype()).itemsize
+        # Pre-walk the fold schedule so the one-time dispatch record
+        # can claim the count that will ACTUALLY dispatch — claiming
+        # nchunks would overstate the modeled fold work exactly when
+        # pruning (or a part-empty capacity tail) is doing its job.
+        schedule = []
+        scanned = 0
+        for t in range(self._nchunks):
+            live_col = None if keep_m is None else keep_m[:, t]
+            spans = [self._block_span(rr, t) for rr in range(r)]
+            real = [hi > lo for lo, hi in spans]
+            if not any(real):
+                continue            # capacity tail: no resident rows yet
+            if live_col is not None and not (live_col & real).any():
+                continue            # every shard pruned this chunk
+            for rr, (lo, hi) in enumerate(spans):
+                if hi > lo and (live_col is None or live_col[rr]):
+                    scanned += (hi - lo) * self.num_attrs * item
+            schedule.append((t, live_col))
+        dispatched = 0
+        throttle = ChunkThrottle()
+        mi = MeasuredIters(self, "fleet.chunk_fold",
+                           (entry.qloc, cr, self.num_attrs, k),
+                           kernel=impl)
+        self._last_select = "extract"
+        with obs_span("fleet.solve_resident", qpad=entry.qpad, kcap=k,
+                      chunks=self._nchunks, scheduled=len(schedule),
+                      impl=impl, mesh=[r, c]):
+            for t, live_col in schedule:
+                lv = self._ones_live if live_col is None \
+                    else jax.device_put(np.asarray(live_col, np.int32),
+                                        self._lsh)
+                if dispatched == 0:
+                    obs_counters.record_dispatch(
+                        step, (cd, ci, self._chunks[t], q_dev,
+                               self._sc_dev[t], lv),
+                        count=len(schedule), site="fleet.chunk_fold")
+                cd, ci, its = step(cd, ci, self._chunks[t], q_dev,
+                                   self._sc_dev[t], lv)
+                mi.add(its)
+                dispatched += 1
+                throttle.tick(cd)
+                telemetry.sample_memory_now()
+        mi.done()
+        blocks_total = sum(1 for rr in range(r)
+                           for t in range(self._nchunks)
+                           if self._block_span(rr, t)[1]
+                           > self._block_span(rr, t)[0])
+        note_scan(self, scanned_bytes=scanned,
+                  dense_bytes=self.n_real * self.num_attrs * item,
+                  blocks_total=(prune_stats or {}).get("blocks_total",
+                                                       blocks_total),
+                  blocks_pruned=(prune_stats or {}).get("blocks_pruned",
+                                                        0))
+        self.last_comms = engine_comms(self._merge_strategy, (r, c),
+                                       entry.qpad // c, k)
+        merge_fn = self._chunk_merge_fn(k)
+        obs_counters.record_dispatch(merge_fn, (cd, ci, self._lab_dev),
+                                     site="fleet.chunk_merge")
+        with obs_span("fleet.merge", mesh=[r, c], kc=k) as sp:
+            top = merge_fn(cd, ci, self._lab_dev)
+            sp.fence(top.dists)
+        return top
+
+    def _solve_resident_stream(self, inp: KNNInput, entry: _MeshBucket):
+        """Streaming fallback on the resident MONOLITHIC arrays: the
+        engines' merged program (collective epilogue inside the jit)."""
+        from dmlp_tpu.ops.summaries import note_scan
+        self._ensure_monolithic()
+        d_attrs, d_labels, d_ids = self._mono
+        q_dev = self._stage_queries(inp, entry.qpad)
+        with obs_span("fleet.solve_stream", qpad=entry.qpad,
+                      kcap=entry.kcap):
+            top = self.solve_global(d_attrs, d_labels, d_ids, q_dev,
+                                    kmax=entry.kb)
+        dense = self.n_real * self.num_attrs \
+            * np.dtype(self._np_dtype()).itemsize
+        note_scan(self, scanned_bytes=dense, dense_bytes=dense,
+                  blocks_total=self.mesh.devices.shape[0],
+                  blocks_pruned=0)
+        return top
+
+    # -- the serving entry ----------------------------------------------------
+
+    def solve_batch(self, query_attrs, ks) -> List[QueryResult]:
+        """One coalesced micro-batch end to end over the mesh: bucket,
+        fold the resident shards, merge across "data", fetch, float64
+        finalize + boundary repair. Results carry query ids 0..nq-1 in
+        batch order — the batcher slices per request."""
+        inp = self._batch_input(np.asarray(query_attrs, np.float64),
+                                np.asarray(ks, np.int32))
+        n = self.n_real
+        nq = inp.params.num_queries
+        kmax = int(inp.ks.max()) if nq else 1
+        self.last_phase_ms = {}
+        self.last_comms = []
+        self._pending_iters = []
+        self.last_extract_impl = None
+        self.last_prune = None
+        self.last_prune_fraction = None
+        memwatch.note_engine_model(self, inp)
+        entry = self._bucket_entry(nq, kmax)
+        if entry.path == "extract":
+            top = self._solve_resident_chunks(inp, entry)
+        else:
+            top = self._solve_resident_stream(inp, entry)
+        telemetry.sample_memory_now()
+        self.last_repairs = 0
+        with obs_span("fleet.fetch"):
+            od, ol, oi = resilient_get((top.dists, top.labels, top.ids),
+                                       site="sharded.fetch")
+            dists = np.asarray(od, np.float64)[:nq]
+            labels = ol[:nq]
+            ids = oi[:nq]
+        with obs_span("fleet.finalize", exact=self.config.exact):
+            results = finalize_host(dists, labels, ids, inp.ks,
+                                    inp.query_attrs, inp.data_attrs,
+                                    exact=self.config.exact)
+            if self._last_select in ("sort", "topk", "seg", "extract") \
+                    and dists.shape[1] < n:
+                # Same per-shard-truncation hazard test as the batch
+                # mesh engines (engine.sharded._run): the merged kcap-th
+                # bounds every shard's horizon, so the eps-widened
+                # boundary test covers per-shard truncation too.
+                dn_max = self._dn_max()
+                qn = np.einsum("qa,qa->q", inp.query_attrs,
+                               inp.query_attrs)
+                eps = staging_eps(
+                    np.asarray(dists[:, -1], np.float64), qn, dn_max,
+                    self._staging, self.num_attrs)
+                suspects = np.nonzero(
+                    boundary_overflow(dists, inp.ks, eps))[0]
+                if suspects.size:
+                    repair_boundary_overflow(results, suspects, inp)
+                    self.last_repairs += int(suspects.size)
+        flush_measured_iters(self)
+        return results
+
+    # -- incremental shard-routed ingestion -----------------------------------
+
+    def ingest(self, labels, attrs) -> int:
+        """Append rows behind the row-count mask. Rows land at their
+        global positions — the owning shard's span of the touched chunk
+        buffers — by restaging exactly those fixed-shape device arrays
+        (and the touched blocks' summaries). No solve program sees a
+        new shape: zero recompilation, counter-asserted."""
+        labels = np.asarray(labels, np.int32).reshape(-1)
+        attrs = np.asarray(attrs, np.float64)
+        if attrs.ndim != 2 or attrs.shape[1] != self.num_attrs:
+            raise ValueError(
+                f"ingest rows must be (m, {self.num_attrs}), "
+                f"got {attrs.shape}")
+        m = attrs.shape[0]
+        if m != labels.shape[0]:
+            raise ValueError("labels/attrs row-count mismatch")
+        if m == 0:
+            return self.n_real
+        start = self.n_real
+        new_n = start + m
+        if new_n > self.capacity_rows:
+            raise CapacityError(
+                f"ingest of {m} rows exceeds capacity "
+                f"{self.capacity_rows} (resident: {start})")
+        r, _ = self.mesh.devices.shape
+        sr, cr = self._shard_rows, self._chunk_rows
+        with obs_span("fleet.ingest", rows=m, corpus_rows=new_n):
+            self._host_attrs[start:new_n] = attrs
+            self._host_labels[start:new_n] = labels
+            self.n_real = new_n
+            self._note_ingested_norms(attrs)
+            # Touched (shard, chunk) blocks from the [start, new_n)
+            # span by block arithmetic — never a per-row Python loop
+            # (a corpus-scale append would stall the solve loop).
+            touched = []
+            for rr in range(r):
+                lo = max(start, rr * sr)
+                hi = min(new_n, (rr + 1) * sr)
+                if hi <= lo:
+                    continue
+                t_lo = (lo - rr * sr) // cr
+                t_hi = (hi - 1 - rr * sr) // cr
+                touched.extend(
+                    (rr, min(t, self._nchunks - 1))
+                    for t in range(t_lo, t_hi + 1))
+            touched = sorted(set(touched))
+            if self._chunks is not None:
+                for t in sorted({t for _rr, t in touched}):
+                    self._chunks[t] = jax.device_put(
+                        self._chunk_host(t), self._csh)
+                self._refresh_scalars()
+                self._rebuild_summary_blocks(touched)
+            self._lab_dev = jax.device_put(
+                np.ascontiguousarray(self._host_labels), self._rsh)
+            if self._mono is not None:
+                self._mono = None
+                self._ensure_monolithic()
+        reg = telemetry.registry()
+        reg.counter("serve.ingested_rows").inc(m)
+        reg.gauge("serve.corpus_rows").set(new_n)
+        return new_n
+
+    # -- memory-model hooks (ResidentServingCore contract) --------------------
+
+    def mem_model(self, nq: int = 0, kmax: int = 0) -> Dict[str, object]:
+        """Per-device fleet model at this engine's own bucket_plan;
+        batch terms included iff ``nq > 0``."""
+        r, c = self.mesh.devices.shape
+        qloc = kcap = 0
+        if nq > 0:
+            qpad, _kb, kcap = self.bucket_plan(nq, max(kmax, 1))
+            qloc = qpad // c
+        return memwatch.fleet_engine_model(
+            mesh_shape=(r, c), shard_rows=self._shard_rows,
+            na=self.num_attrs, staging=self._staging,
+            chunks=(self._nchunks if self._chunks is not None else 0),
+            chunk_rows=self._chunk_rows,
+            monolithic=self._mono is not None,
+            capacity_rows=self.capacity_rows,
+            summary_blocks=(r * self._nchunks if self._summ is not None
+                            else 0),
+            qloc=qloc, kcap=kcap, merge=self._merge_strategy)
+
+    def batch_model_bytes(self, nq: int, kmax: int) -> int:
+        """Marginal per-device bytes of one micro-batch bucket on top
+        of the resident floor (query shard + local lists + the merge
+        buffer — the allgather merge materializes all R shards' lists)."""
+        terms = self.mem_model(nq, kmax)["terms"]
+        return int(terms.get("query_shard", 0)
+                   + terms.get("local_topk", 0)
+                   + terms.get("merge_buffer", 0))
+
+    def resident_state_key(self):
+        # The per-device floor moves when the monolithic layout stages
+        # lazily (a stream-path bucket on an extract-capable config
+        # adds a second full corpus copy per device).
+        return (self._chunks is not None, self._mono is not None)
+
+    # -- introspection --------------------------------------------------------
+
+    def bucket_stats(self) -> Dict[str, object]:
+        entries = list(self._buckets.values())
+        lp = self.last_prune
+        r, c = self.mesh.devices.shape
+        return {
+            "buckets": sorted(e.key for e in entries),
+            "paths": {e.key: e.path for e in entries},
+            "compile_count": self.compile_count,
+            "bucket_compile_ms": dict(self.bucket_compile_ms),
+            "cold_start_compile_ms": self.cold_start_compile_ms,
+            "corpus_rows": self.n_real,
+            "capacity_rows": self.capacity_rows,
+            "gate_carry": self.gate_carry,
+            "last_gated_fraction": self.last_gated_fraction,
+            "extract_chunks": self._nchunks if self._chunks else 0,
+            "summary_blocks": (r * self._nchunks if self._summ else 0),
+            "summary_rebuilds": self.summary_rebuilds,
+            "last_prune_fraction": self.last_prune_fraction,
+            "last_prune": dict(lp) if isinstance(lp, dict) else None,
+            "mesh": [r, c],
+            "merge": self._merge_strategy,
+            "shard_rows": self._shard_rows,
+        }

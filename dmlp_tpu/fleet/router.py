@@ -1,0 +1,515 @@
+"""The fleet front end: one line-JSON TCP port over N daemon replicas.
+
+The router speaks the daemon's wire protocol on both sides and stays
+deliberately thin — it never parses query payloads beyond the ``op``
+field, and it relays each replica's response LINE verbatim, so the
+bytes a client sees are exactly the bytes one daemon produced (fleet
+byte-identity reduces to daemon byte-identity).
+
+Routing policy (the part the tests pin down):
+
+- **Queries** go to the least-loaded healthy, non-draining replica.
+  They are idempotent pure reads, so a replica failing MID-REQUEST
+  (connection refused/reset/EOF — classified via the resilience
+  transient table) is retried on a DIFFERENT replica, bounded by the
+  replica count; the client still receives exactly one response. A
+  ``rejected: draining`` response is replica-local, not backpressure:
+  it retries elsewhere too. Every OTHER rejection — admission sheds
+  (``memory``/``queue_full``/``injected_squeeze``), shape/k caps — is
+  the fleet's explicit backpressure signal and propagates to the
+  client UNRETRIED (re-offering shed load elsewhere would defeat
+  admission control under correlated pressure).
+- **Ingest** fans out to EVERY live replica (all serve the same
+  corpus; a partial ingest would fork the fleet's corpus, so any
+  failure reports which replicas diverged — never silently retried:
+  ingest is not idempotent).
+- **stats** aggregates per-replica stats with the router's own
+  counters; **drain** propagates to every replica, then drains the
+  router itself (rc 0 — the smoke's drain contract).
+
+Health: a background prober calls each replica's ``stats`` op on an
+interval, reviving marked-dead replicas that answer again and marking
+draining ones; request-path failures mark immediately. Replica
+connections are PER-REQUEST (no shared sockets), so no thread ever
+blocks on I/O while holding a lock — check rule R703 stays clean by
+construction.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from dmlp_tpu.obs import telemetry
+from dmlp_tpu.resilience.retry import classify
+
+#: request-line cap mirrored from the daemon protocol
+from dmlp_tpu.serve.protocol import MAX_LINE_BYTES, encode
+
+
+class Replica:
+    """One backend daemon endpoint + its guarded health/load state.
+
+    I/O is connection-per-call: ``call`` opens a fresh socket, sends
+    one line, reads one line — never under any lock (leaf ``_lock``
+    guards pure state only)."""
+
+    def __init__(self, host: str, port: int,
+                 scrape_port: Optional[int] = None, index: int = 0):
+        self.host, self.port = host, int(port)
+        self.scrape_port = scrape_port
+        self.index = index
+        self.name = f"{host}:{port}"
+        self._lock = threading.Lock()
+        self._healthy = True
+        self._draining = False
+        self._inflight = 0
+        self._requests = 0
+        self._failures = 0
+        self._last_error: Optional[str] = None
+
+    # -- guarded state ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"replica": self.name, "healthy": self._healthy,
+                    "draining": self._draining,
+                    "inflight": self._inflight,
+                    "requests": self._requests,
+                    "failures": self._failures,
+                    "last_error": self._last_error}
+
+    def mark(self, healthy: Optional[bool] = None,
+             draining: Optional[bool] = None,
+             error: Optional[str] = None) -> None:
+        with self._lock:
+            if healthy is not None:
+                self._healthy = healthy
+            if draining is not None:
+                self._draining = draining
+            if error is not None:
+                self._last_error = error
+                self._failures += 1
+
+    def available(self) -> bool:
+        with self._lock:
+            return self._healthy and not self._draining
+
+    def load(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def _begin(self, probe: bool) -> None:
+        with self._lock:
+            self._inflight += 1
+            if not probe:
+                self._requests += 1
+
+    def _end(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    # -- the wire --------------------------------------------------------------
+
+    def call(self, line: bytes, timeout_s: float = 600.0,
+             probe: bool = False) -> bytes:
+        """One request line -> the replica's raw response line. Raises
+        OSError/ConnectionError on transport failure (the router
+        classifies and retries); inflight accounting brackets the call
+        so least-loaded picking sees in-progress work. ``probe=True``
+        (health probes, drain propagation) keeps the per-replica
+        ``requests`` stat CLIENT traffic only — a replica that served
+        nothing must not look busy because the prober pinged it."""
+        self._begin(probe)
+        try:
+            with socket.create_connection((self.host, self.port),
+                                          timeout=timeout_s) as sock:
+                sock.sendall(line)
+                with sock.makefile("rb") as rf:
+                    resp = rf.readline(MAX_LINE_BYTES + 1)
+            if not resp:
+                raise ConnectionError(
+                    f"replica {self.name} closed the connection "
+                    "mid-request")
+            return resp
+        finally:
+            self._end()
+
+
+class _RouterHandler(socketserver.StreamRequestHandler):
+    """One client connection: requests answered strictly in line order
+    (mirrors the daemon handler's framing and size-cap discipline)."""
+
+    def handle(self):  # noqa: D102 (socketserver API)
+        router: FleetRouter = self.server.router
+        while True:
+            raw = self.rfile.readline(MAX_LINE_BYTES + 1)
+            if not raw:
+                break
+            if len(raw) > MAX_LINE_BYTES:
+                self.wfile.write(encode(
+                    {"ok": False,
+                     "error": "request line exceeds the size cap"}))
+                break
+            if not raw.strip():
+                continue
+            router._track_inflight(+1)
+            try:
+                resp_line, closing = router.handle_line(raw)
+                self.wfile.write(resp_line)
+                self.wfile.flush()
+            finally:
+                router._track_inflight(-1)
+            if closing:
+                break
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class FleetRouter:
+    """Lifecycle owner: replica table + health prober + TCP front end
+    + aggregated telemetry endpoint."""
+
+    def __init__(self, replicas: List[Tuple[str, int]],
+                 scrape_ports: Optional[List[Optional[int]]] = None,
+                 port: int = 0, health_interval_s: float = 1.0,
+                 request_timeout_s: float = 600.0,
+                 telemetry_port: Optional[int] = None):
+        scrape_ports = scrape_ports or [None] * len(replicas)
+        if len(scrape_ports) != len(replicas):
+            raise ValueError("one scrape port per replica (or none)")
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        # The registry is process-global but stats() divides by THIS
+        # router's lifetime: zero the fleet.* counters so a second
+        # router in one process (tests, embedders) doesn't inherit the
+        # first one's retries/rejections — same discipline as the
+        # daemon's serve.* reset.
+        telemetry.registry().reset(prefix="fleet")
+        self.replicas = [Replica(h, p, scrape_port=sp, index=i)
+                         for i, ((h, p), sp)
+                         in enumerate(zip(replicas, scrape_ports))]
+        self.request_timeout_s = request_timeout_s
+        self.health_interval_s = health_interval_s
+        self._lock = threading.Lock()     # guards _rr + _draining only
+        self._rr = 0
+        self._draining = False
+        self._drain_event = threading.Event()
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
+        self._stop_health = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        self._server = _Server(("127.0.0.1", port), _RouterHandler)
+        self._server.router = self
+        self.port = self._server.server_address[1]
+        self._server_thread: Optional[threading.Thread] = None
+        self._telemetry_port = telemetry_port
+        self._telemetry_httpd = None
+        self._t_ready: Optional[float] = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        self._probe_all()
+        stop = self._stop_health
+        self._health_thread = threading.Thread(
+            target=self._health_loop, args=(stop,), name="fleet-health",
+            daemon=True)
+        self._health_thread.start()
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, name="fleet-accept",
+            daemon=True)
+        self._server_thread.start()
+        if self._telemetry_port is not None:
+            self._start_telemetry_http(self._telemetry_port)
+        self._t_ready = time.monotonic()
+        telemetry.registry().gauge("fleet.ready").set(1)
+
+    def run_until_drained(self) -> None:
+        while not self._drain_event.wait(timeout=0.2):
+            pass
+        self.drain()
+
+    def request_drain(self) -> None:
+        self._drain_event.set()
+
+    def drain(self, propagate: bool = True) -> None:
+        """Shed new work, propagate the drain to every replica, wait
+        for in-flight relays to finish, close. rc 0 — not a crash."""
+        with self._lock:
+            self._draining = True
+        telemetry.registry().gauge("fleet.ready").set(0)
+        if propagate:
+            for rep in self.replicas:
+                try:
+                    rep.call(b'{"op": "drain"}\n', timeout_s=30.0,
+                             probe=True)
+                except OSError:
+                    pass   # already gone: that IS drained
+                rep.mark(draining=True)
+        self._server.shutdown()
+        self._wait_inflight_drained()
+        self._stop_health.set()
+        if self._telemetry_httpd is not None:
+            self._telemetry_httpd.shutdown()
+        self._server.server_close()
+
+    def close(self) -> None:
+        """Abrupt teardown for tests (no drain propagation)."""
+        with self._lock:
+            self._draining = True
+        self._drain_event.set()
+        self._stop_health.set()
+        self._server.shutdown()
+        if self._telemetry_httpd is not None:
+            self._telemetry_httpd.shutdown()
+        self._server.server_close()
+
+    def _track_inflight(self, delta: int) -> None:
+        with self._inflight_cond:
+            self._inflight += delta
+            if self._inflight <= 0:
+                self._inflight_cond.notify_all()
+
+    def _wait_inflight_drained(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        with self._inflight_cond:
+            while self._inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return
+                self._inflight_cond.wait(timeout=left)
+
+    # -- health ----------------------------------------------------------------
+
+    def _probe(self, rep: Replica) -> None:
+        try:
+            raw = rep.call(b'{"op": "stats"}\n', timeout_s=10.0,
+                           probe=True)
+            doc = json.loads(raw)
+            draining = bool(
+                doc.get("stats", {}).get("admission", {}).get("draining"))
+            rep.mark(healthy=True, draining=draining)
+        except (OSError, ValueError) as e:
+            rep.mark(healthy=False, error=f"probe: {e}")
+
+    def _probe_all(self) -> None:
+        for rep in self.replicas:
+            self._probe(rep)
+        telemetry.registry().gauge("fleet.replicas_healthy").set(
+            sum(1 for r in self.replicas if r.available()))
+
+    def _health_loop(self, stop: threading.Event) -> None:
+        while not stop.wait(timeout=self.health_interval_s):
+            self._probe_all()
+
+    # -- routing ---------------------------------------------------------------
+
+    def _pick(self, exclude) -> Optional[Replica]:
+        """Least-inflight available replica, round-robin on ties."""
+        avail = [r for r in self.replicas
+                 if r not in exclude and r.available()]
+        if not avail:
+            return None
+        with self._lock:
+            self._rr += 1
+            rr = self._rr
+        return min(avail,
+                   key=lambda r: (r.load(), (r.index - rr) % 1009))
+
+    def _draining_now(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def handle_line(self, raw: bytes) -> Tuple[bytes, bool]:
+        """One client line -> (response line, close-connection?)."""
+        reg = telemetry.registry()
+        t0 = time.monotonic()
+        try:
+            obj = json.loads(raw)
+            op = obj.get("op", "query") if isinstance(obj, dict) \
+                else "invalid"
+        except ValueError:
+            op = "query"    # let a daemon produce the protocol error
+        reg.counter("fleet.requests").inc(label=str(op))
+        if op == "stats":
+            return encode({"ok": True, "stats": self.stats()}), False
+        if op == "drain":
+            self._drain_event.set()
+            return encode({"ok": True, "draining": True}), True
+        if self._draining_now():
+            reg.counter("fleet.rejected").inc(label="draining")
+            return encode({"ok": False, "error": "rejected: draining",
+                           "draining": True}), True
+        if op == "ingest":
+            resp = self._route_ingest(raw)
+        else:
+            resp = self._route_query(raw)
+        reg.histogram("fleet.request_latency_ms", unit="ms").observe(
+            (time.monotonic() - t0) * 1e3)
+        return resp, False
+
+    def _route_query(self, raw: bytes) -> bytes:
+        """Bounded retry-on-replica-failure: transport failures and
+        replica-local draining rejections move on to the next replica
+        (queries are idempotent reads — exactly one response either
+        way); everything else relays verbatim."""
+        reg = telemetry.registry()
+        tried: set = set()
+        last_error = "no healthy replica"
+        for _attempt in range(len(self.replicas)):
+            rep = self._pick(tried)
+            if rep is None:
+                break
+            tried.add(rep)
+            try:
+                resp = rep.call(raw, timeout_s=self.request_timeout_s)
+            except OSError as e:
+                # The resilience classification decides retryability:
+                # connection refused/reset/EOF/timeouts all classify
+                # transient — mark the replica down (the prober revives
+                # it) and retry on a healthy one.
+                kind = classify(e)
+                rep.mark(healthy=False, error=str(e))
+                reg.counter("fleet.replica_failures").inc(
+                    label=rep.name)
+                last_error = f"replica {rep.name}: {e}"
+                if kind not in ("transient", "oom"):
+                    break
+                reg.counter("fleet.retries").inc(label="failure")
+                continue
+            try:
+                doc = json.loads(resp)
+            except ValueError:
+                doc = {}
+            err = str(doc.get("error", ""))
+            if doc.get("ok") is False and "draining" in err:
+                # Replica-local shutdown, not fleet backpressure.
+                rep.mark(draining=True)
+                reg.counter("fleet.retries").inc(label="draining")
+                last_error = f"replica {rep.name}: draining"
+                continue
+            if doc.get("ok") is False and err.startswith("rejected"):
+                # Admission shed: the explicit backpressure signal,
+                # propagated unretried.
+                reg.counter("fleet.rejected").inc(label="admission")
+            return resp
+        reg.counter("fleet.rejected").inc(label="unavailable")
+        return encode({"ok": False,
+                       "error": f"rejected: {last_error}"})
+
+    def _route_ingest(self, raw: bytes) -> bytes:
+        """Fan-out to every available replica; ALL must accept (a
+        partial ingest forks the fleet corpus — the response names the
+        divergent replicas instead of hiding them)."""
+        reg = telemetry.registry()
+        targets = [r for r in self.replicas if r.available()]
+        if not targets:
+            reg.counter("fleet.rejected").inc(label="unavailable")
+            return encode({"ok": False,
+                           "error": "rejected: no healthy replica"})
+        oks: List[bytes] = []
+        failures: List[str] = []
+        for rep in targets:
+            try:
+                resp = rep.call(raw, timeout_s=self.request_timeout_s)
+                doc = json.loads(resp)
+            except (OSError, ValueError) as e:
+                rep.mark(healthy=False, error=str(e))
+                failures.append(f"{rep.name}: {e}")
+                continue
+            if doc.get("ok"):
+                oks.append(resp)
+            else:
+                failures.append(f"{rep.name}: {doc.get('error')}")
+        if failures or not oks:
+            reg.counter("fleet.ingest_divergence").inc()
+            return encode({"ok": False, "error":
+                           "ingest diverged: " + "; ".join(failures),
+                           "accepted_replicas": len(oks)})
+        return oks[0]
+
+    # -- stats + telemetry -----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        reg = telemetry.registry()
+        elapsed = (time.monotonic() - self._t_ready) \
+            if self._t_ready else 0.0
+        out: Dict[str, Any] = {
+            "fleet": True,
+            "replicas": [r.snapshot() for r in self.replicas],
+            "healthy_replicas": sum(1 for r in self.replicas
+                                    if r.available()),
+            "draining": self._draining_now(),
+            "uptime_s": round(elapsed, 3),
+            "requests": reg.counter("fleet.requests").by_label(),
+            "retries": reg.counter("fleet.retries").by_label(),
+            "rejected": reg.counter("fleet.rejected").by_label(),
+        }
+        h = reg.get("fleet.request_latency_ms")
+        if h is not None and h.count:
+            out["request_latency_ms"] = {
+                "p50": round(h.quantile(0.5), 3),
+                "p95": round(h.quantile(0.95), 3),
+                "p99": round(h.quantile(0.99), 3),
+                "count": h.count,
+            }
+        return out
+
+    def fleet_metrics_text(self) -> str:
+        """The aggregated fleet OpenMetrics view: every replica's live
+        scrape (those with a scrape port) merged by fleet.scrape, plus
+        the router's own registry as one more 'replica'."""
+        from dmlp_tpu.fleet import scrape as fscrape
+        texts = [telemetry.registry().to_openmetrics()]
+        names = ["router"]
+        for rep in self.replicas:
+            if rep.scrape_port is None:
+                continue
+            try:
+                texts.append(fscrape.scrape_url(
+                    f"http://{rep.host}:{rep.scrape_port}/metrics"))
+                names.append(rep.name)
+            except OSError:
+                continue   # down replica: degrade, don't vanish
+        merged, _problems = fscrape.merge_expositions(texts, names)
+        return merged
+
+    def _start_telemetry_http(self, port: int) -> None:
+        import http.server
+
+        router = self
+
+        class _MetricsHandler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                body = router.fleet_metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/openmetrics-text")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-scrape stderr
+                pass
+
+        class _Httpd(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+
+        self._telemetry_httpd = _Httpd(("127.0.0.1", port),
+                                       _MetricsHandler)
+        self.telemetry_port = self._telemetry_httpd.server_address[1]
+        threading.Thread(target=self._telemetry_httpd.serve_forever,
+                         name="fleet-metrics", daemon=True).start()
+        telemetry.registry().gauge("fleet.telemetry_http_port").set(
+            self.telemetry_port)

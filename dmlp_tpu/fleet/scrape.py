@@ -1,0 +1,221 @@
+"""Fleet OpenMetrics aggregation: N replica scrapes -> one exposition.
+
+Every daemon replica exposes the PR 9 registry on its
+``--telemetry-port`` (or snapshot file). The fleet view merges them by
+metric semantics, not by string concatenation:
+
+- **counters** sum — the fleet served the sum of what its replicas
+  served (per-``key`` labeled totals sum per key);
+- **histograms** merge BUCKET-WISE: every replica shares the registry's
+  fixed log-spaced bounds, so per-``le`` cumulative counts convert to
+  per-bucket deltas, sum across replicas over the union of emitted
+  bounds, and re-emit cumulative (``_sum``/``_count`` sum) — fleet
+  quantiles keep the same ~5.9% worst-case error as a single replica's;
+- **gauges** stay PER-REPLICA, labeled ``{replica="..."}`` — averaging
+  a corpus-rows or headroom gauge across replicas would manufacture a
+  number no process reports.
+
+The merged exposition passes ``obs.telemetry.validate_openmetrics``
+(asserted in tests and the fleet smoke); ``tools/fleet_scrape.py`` is
+the CLI, and the router's ``--telemetry-port`` serves the same merge
+live.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|histogram)$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{(?P<labels>[^{}]*)\})? (?P<value>\S+)$")
+
+
+class ParsedExposition:
+    """One scrape, decomposed by metric base name."""
+
+    def __init__(self):
+        self.kinds: Dict[str, str] = {}
+        self.help: Dict[str, str] = {}
+        # counters/gauges: base -> {label_body ("" = unlabeled): value}
+        self.samples: Dict[str, Dict[str, float]] = {}
+        # histograms: base -> {"buckets": [(le, cum)], "sum": x,
+        #                      "count": n}
+        self.hists: Dict[str, Dict[str, object]] = {}
+        self.problems: List[str] = []
+
+
+def parse_exposition(text: str) -> ParsedExposition:
+    """Parse an OpenMetrics text exposition into merge-ready structure
+    (tolerant: malformed lines are recorded as problems, not raised —
+    a half-written snapshot must not take down the fleet view)."""
+    out = ParsedExposition()
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line or line == "# EOF":
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            if m:
+                out.kinds[m.group(1)] = m.group(2)
+            elif line.startswith("# HELP "):
+                parts = line.split(" ", 3)
+                if len(parts) == 4:
+                    out.help[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            out.problems.append(f"line {i}: malformed sample {line!r}")
+            continue
+        name, labels = m.group("name"), m.group("labels") or ""
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            out.problems.append(f"line {i}: non-numeric {line!r}")
+            continue
+        # A declared name wins over suffix stripping: a GAUGE legally
+        # named ..._count must not be misfiled under a stripped base.
+        if name in out.kinds:
+            base, kind = name, out.kinds[name]
+        else:
+            base = re.sub(r"(_total|_bucket|_sum|_count)$", "", name)
+            kind = out.kinds.get(base)
+        if kind == "histogram":
+            h = out.hists.setdefault(
+                base, {"buckets": [], "sum": 0.0, "count": 0})
+            if name.endswith("_bucket"):
+                le = math.inf
+                lm = re.search(r'le="([^"]*)"', labels)
+                if lm and lm.group(1) != "+Inf":
+                    le = float(lm.group(1))
+                h["buckets"].append((le, int(value)))
+            elif name.endswith("_sum"):
+                h["sum"] = value
+            elif name.endswith("_count"):
+                h["count"] = int(value)
+            continue
+        tgt = name if kind is None else base
+        out.samples.setdefault(tgt, {})[labels] = value
+        if kind is None:
+            out.problems.append(f"line {i}: sample {name!r} has no "
+                                "preceding # TYPE")
+    return out
+
+
+def _bucket_deltas(buckets: List[Tuple[float, int]]
+                   ) -> Dict[float, int]:
+    """(le, cumulative) pairs (sparse render) -> per-bucket deltas."""
+    deltas: Dict[float, int] = {}
+    prev = 0
+    for le, cum in sorted(buckets, key=lambda b: b[0]):
+        deltas[le] = cum - prev
+        prev = cum
+    return deltas
+
+
+def _om_num(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def merge_expositions(texts: List[str],
+                      replica_names: Optional[List[str]] = None
+                      ) -> Tuple[str, List[str]]:
+    """Merge N scrapes into one fleet exposition; returns (text,
+    problems). Kind conflicts across replicas (impossible with one
+    codebase, detected anyway) keep the first registration and report."""
+    names = replica_names or [f"r{i}" for i in range(len(texts))]
+    parsed = [parse_exposition(t) for t in texts]
+    problems: List[str] = []
+    for name, p in zip(names, parsed):
+        problems.extend(f"{name}: {x}" for x in p.problems)
+
+    kinds: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for p in parsed:
+        for base, kind in p.kinds.items():
+            if base in kinds and kinds[base] != kind:
+                problems.append(
+                    f"kind conflict for {base}: {kinds[base]} vs {kind}")
+                continue
+            kinds.setdefault(base, kind)
+            if base in p.help:
+                helps.setdefault(base, p.help[base])
+
+    lines: List[str] = []
+    for base in sorted(kinds):
+        kind = kinds[base]
+        lines.append(f"# TYPE {base} {kind}")
+        if base in helps:
+            lines.append(f"# HELP {base} {helps[base]}")
+        if kind == "counter":
+            totals: Dict[str, float] = {}
+            for p in parsed:
+                for labels, v in p.samples.get(base, {}).items():
+                    totals[labels] = totals.get(labels, 0.0) + v
+            for labels in sorted(totals):
+                lab = f"{{{labels}}}" if labels else ""
+                lines.append(
+                    f"{base}_total{lab} {_om_num(totals[labels])}")
+        elif kind == "gauge":
+            for rname, p in zip(names, parsed):
+                for labels, v in sorted(p.samples.get(base, {}).items()):
+                    lab = (f'{{{labels},replica="{rname}"}}' if labels
+                           else f'{{replica="{rname}"}}')
+                    lines.append(f"{base}{lab} {_om_num(v)}")
+        else:                                           # histogram
+            deltas: Dict[float, int] = {}
+            total_sum = 0.0
+            total_count = 0
+            for p in parsed:
+                h = p.hists.get(base)
+                if not h:
+                    continue
+                for le, d in _bucket_deltas(h["buckets"]).items():
+                    deltas[le] = deltas.get(le, 0) + d
+                total_sum += float(h["sum"])
+                total_count += int(h["count"])
+            deltas.setdefault(math.inf, 0)
+            cum = 0
+            for le in sorted(deltas):
+                cum += deltas[le]
+                le_s = "+Inf" if le == math.inf else _om_num(le)
+                lines.append(f'{base}_bucket{{le="{le_s}"}} {cum}')
+            lines.append(f"{base}_sum {_om_num(total_sum)}")
+            lines.append(f"{base}_count {total_count}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n", problems
+
+
+def scrape_url(url: str, timeout_s: float = 10.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return r.read().decode()
+
+
+def fleet_view(sources: List[str],
+               replica_names: Optional[List[str]] = None,
+               timeout_s: float = 10.0) -> Tuple[str, List[str]]:
+    """One aggregated exposition from per-replica sources (``http://``
+    URLs are scraped, anything else reads as a snapshot file path);
+    unreachable replicas become problems, never exceptions — the fleet
+    view must degrade, not vanish, when one replica is down."""
+    texts: List[str] = []
+    names: List[str] = []
+    problems: List[str] = []
+    wanted = replica_names or [f"r{i}" for i in range(len(sources))]
+    for name, src in zip(wanted, sources):
+        try:
+            if src.startswith(("http://", "https://")):
+                texts.append(scrape_url(src, timeout_s=timeout_s))
+            else:
+                with open(src) as f:
+                    texts.append(f.read())
+            names.append(name)
+        except OSError as e:
+            problems.append(f"{name}: unreachable ({e})")
+    merged, merge_problems = merge_expositions(texts, names)
+    return merged, problems + merge_problems

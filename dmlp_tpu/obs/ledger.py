@@ -88,7 +88,7 @@ _LOWER_BETTER_HINTS = ("_ms", "_s", "_us", "_sec", "ms", "elapsed",
 # qd_pairs_per_sec is already covered by "per_sec".
 _HIGHER_BETTER_HINTS = ("per_sec", "per_chip", "mfu",
                        "tflops", "pct_of_roof", "samples", "speedup",
-                       "efficiency")
+                       "efficiency", "qps")
 
 
 def _better_direction(metric: str) -> str:
@@ -289,6 +289,18 @@ def _runrecord_series_name(rec: RunRecord, key: str) -> str:
         return f"telemetry{cfg_tag}/{key}"
     if rec.kind == "serve":
         return f"serve/{key}"
+    if rec.kind == "fleet":
+        # Open-loop SLO records (fleet.loadgen) + the router snapshot:
+        # one ``fleet/<level>/<metric>`` series per offered-load level
+        # (config "level" = "x1", "x2", ... or "router"), so the
+        # p99-under-offered-load curve gates level-by-level in
+        # tools/perf_gate.py — a regression at x4 can't hide behind an
+        # improvement at x1.
+        lvl = rec.config.get("level") if isinstance(rec.config, dict) \
+            else None
+        tag = (f"/{lvl}" if lvl
+               else (f"/config{cid}" if cid is not None else ""))
+        return f"fleet{tag}/{key}"
     if rec.tool == "tools.trainbench_moe":
         m = re.match(r"(dense|a2a)_(.+)$", key)
         if m:

@@ -284,7 +284,8 @@ def serve_engine_model(capacity_rows: int, na: int,
                        staging: str = "float32", qpad: int = 0,
                        kcap: int = 0, extract_chunks: int = 0,
                        chunk_rows: int = 0,
-                       summary_blocks: int = 0) -> Dict[str, Any]:
+                       summary_blocks: int = 0,
+                       multipass_rows: int = 0) -> Dict[str, Any]:
     """Peak resident device bytes for the serving layer's
     :class:`~dmlp_tpu.serve.engine.ResidentEngine`: the capacity-padded
     resident corpus (+ labels/ids mask arrays), the extract path's
@@ -305,11 +306,56 @@ def serve_engine_model(capacity_rows: int, na: int,
         # solve (ops.summaries.stage_summaries): two (B, A) f32 boxes,
         # two (B,) f32 norm bands, one (B,) i32 count vector.
         terms["resident_summaries"] = summary_blocks * (8 * na + 12)
+    if multipass_rows:
+        # The wide-k multipass path keeps a SECOND full copy of the
+        # resident chunks concatenated on device (passes 2+ re-sweep
+        # it whole); un-modeled it would let admission over-admit by a
+        # corpus once the first wide-k bucket warms.
+        terms["multipass_resident"] = multipass_rows * na * item
     if qpad:
         terms["query_blocks"] = qpad * na * item
         terms["topk_carries"] = 2 * qpad * kcap * _TOPK_ITEMSIZE
     return _finish(terms, kind="serve", capacity_rows=capacity_rows,
                    staging=staging)
+
+
+def fleet_engine_model(mesh_shape, shard_rows: int, na: int,
+                       staging: str = "float32", chunks: int = 0,
+                       chunk_rows: int = 0, monolithic: bool = False,
+                       capacity_rows: int = 0, summary_blocks: int = 0,
+                       qloc: int = 0, kcap: int = 0,
+                       merge: str = "allgather") -> Dict[str, Any]:
+    """Peak resident bytes PER DEVICE for the mesh-resident serving
+    engine (:class:`~dmlp_tpu.fleet.mesh_engine.MeshResidentEngine`):
+    each device holds its shard's resident chunk buffers (or the
+    monolithic shard slice), the replicated label vector, its share of
+    the resident summaries, and — when a micro-batch bucket (qloc,
+    kcap) is given — that batch's transient terms: the per-column query
+    shard, the local candidate lists, and the merge buffer (all R
+    shards' lists for the all-gather merge, the O(k) accumulator for
+    the ring). The admission controller reads the corpus terms as the
+    per-device floor and prices each bucket's marginal bytes on top."""
+    item = _staging_itemsize(staging)
+    r, c = mesh_shape
+    terms: Dict[str, int] = {
+        # Replicated labels ride every device (tiny — int32 * capacity).
+        "labels_replicated": max(capacity_rows, r * shard_rows) * 4,
+    }
+    if chunks:
+        terms["resident_chunks"] = chunks * chunk_rows * na * item
+    if monolithic:
+        terms["monolithic_shard"] = shard_rows * na * item
+        terms["labels_ids_shard"] = shard_rows * 8
+    if summary_blocks:
+        terms["resident_summaries"] = summary_blocks * (8 * na + 12)
+    if qloc:
+        terms["query_shard"] = qloc * na * item
+        terms["local_topk"] = qloc * kcap * _TOPK_ITEMSIZE
+        terms["merge_buffer"] = (r if merge == "allgather" else 2) \
+            * qloc * kcap * _TOPK_ITEMSIZE
+    return _finish(terms, kind="fleet", mesh=[r, c],
+                   shard_rows=shard_rows, staging=staging,
+                   per_device=True, n_devices=r * c)
 
 
 def _finish(terms: Dict[str, int], **meta) -> Dict[str, Any]:
@@ -331,6 +377,8 @@ def resident_bytes_model(kind: str, **params) -> Dict[str, Any]:
         return train_step_model(**params)
     if kind == "serve":
         return serve_engine_model(**params)
+    if kind == "fleet":
+        return fleet_engine_model(**params)
     raise ValueError(f"unknown workload kind {kind!r}")
 
 
@@ -340,18 +388,12 @@ def model_for_engine(engine, inp) -> Dict[str, Any]:
     solve will resolve."""
     p = inp.params
     kmax = int(inp.ks.max()) if p.num_queries else 1
-    if hasattr(engine, "capacity_rows"):      # serve.ResidentEngine
-        # bucket_plan is the ONE kcap derivation (matches what
-        # _build_bucket compiles — no drift between model and solve)
-        qpad, _kb, kcap = engine.bucket_plan(p.num_queries, kmax)
-        return serve_engine_model(
-            engine.capacity_rows, p.num_attrs, staging=engine._staging,
-            qpad=qpad, kcap=kcap,
-            extract_chunks=(engine._ex_nchunks if engine._chunks else 0),
-            chunk_rows=engine._ex_chunk_rows,
-            summary_blocks=(engine._ex_nchunks
-                            if getattr(engine, "_summ_dev", None)
-                            is not None else 0))
+    if hasattr(engine, "mem_model"):
+        # The resident serving engines (serve.ResidentEngine,
+        # fleet.MeshResidentEngine) own their model parameterization —
+        # bucket_plan is the one kcap derivation, so the model cannot
+        # drift from what the solve allocates.
+        return engine.mem_model(p.num_queries, kmax)
     if type(engine).__name__ == "SingleChipEngine":
         return single_engine_model(p.num_data, p.num_queries, p.num_attrs,
                                    kmax, config=engine.config,

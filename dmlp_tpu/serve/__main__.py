@@ -78,6 +78,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--data-block", type=int, default=None)
     p.add_argument("--warm-buckets", default=None, metavar="NQxK,...",
                    help="extra shape buckets to compile before ready")
+    p.add_argument("--mesh", default=None, metavar="RxC",
+                   help="serve MESH-RESIDENT: hold the corpus sharded "
+                        "across an RxC device mesh "
+                        "(dmlp_tpu.fleet.mesh_engine; per-shard "
+                        "resident buffers, allgather/ring merge as the "
+                        "micro-batch epilogue)")
+    p.add_argument("--mesh-merge", choices=["allgather", "ring"],
+                   default="allgather",
+                   help="candidate-merge collective for --mesh")
     p.add_argument("--compile-cache", metavar="DIR", default=None,
                    help="persistent XLA compilation cache dir (best "
                         "effort; restarts then reuse executables)")
@@ -127,6 +136,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     config = EngineConfig(dtype=args.dtype, select=args.select,
                           use_pallas=args.pallas,
                           data_block=args.data_block)
+    mesh_shape = None
+    if args.mesh:
+        try:
+            r, c = args.mesh.lower().split("x")
+            mesh_shape = (int(r), int(c))
+        except ValueError:
+            raise SystemExit(f"--mesh is RxC, got {args.mesh!r}")
     schedule = rs_inject.install_from_env(args.faults)
     daemon = ServeDaemon(
         corpus, config, port=args.port, capacity=args.capacity,
@@ -135,7 +151,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         max_queue_queries=args.max_queue_queries, max_k=args.max_k,
         tick_s=args.tick_ms / 1e3, telemetry_path=args.telemetry,
         telemetry_port=args.telemetry_port, record_path=args.record,
-        snapshot_every_s=args.snapshot_every_s, warm_buckets=warm)
+        snapshot_every_s=args.snapshot_every_s, warm_buckets=warm,
+        mesh_shape=mesh_shape, mesh_merge=args.mesh_merge)
     try:
         daemon.start()
         sys.stderr.write(f"dmlp_tpu.serve: ready port={daemon.port} "

@@ -83,38 +83,33 @@ class AdmissionController:
 
     def batch_bytes(self, nq: int, kmax: int) -> int:
         """Marginal resident bytes a micro-batch of this shape bucket
-        adds on top of the resident corpus — the per-bucket terms of
-        ``memwatch.serve_engine_model`` at the engine's own
-        ``bucket_plan`` (the one kcap derivation), so the pricing
-        cannot drift from what the solve allocates."""
-        eng = self.engine
-        qpad, _kb, kcap = eng.bucket_plan(nq, kmax)
-        terms = memwatch.serve_engine_model(
-            eng.capacity_rows, eng.num_attrs, staging=eng._staging,
-            qpad=qpad, kcap=kcap)["terms"]
-        return int(terms["query_blocks"] + terms["topk_carries"])
+        adds on top of the resident corpus — every resident engine
+        prices its own per-bucket terms at its own ``bucket_plan``
+        (ResidentServingCore.batch_model_bytes: the one kcap
+        derivation, so pricing cannot drift from what the solve
+        allocates; term names differ between the single-chip and mesh
+        models, which is why the engine owns the sum)."""
+        return int(self.engine.batch_model_bytes(nq, kmax))
 
     def _resident_model_bytes(self) -> int:
-        """The corpus-only model total, cached — it only moves when the
-        extract chunks stage (every other input is fixed at engine
-        construction), so rebuilding the dict per request is pure
-        hot-path waste. The memo is read both under the batcher's
-        queue lock (decide_queued) and from handler threads
-        (snapshot), hence its own guard."""
-        chunks_staged = self.engine._chunks is not None
+        """The corpus-only model total, cached — it only moves when
+        the engine's resident_state_key changes (lazy stagings: extract
+        chunks, the wide-k multipass concat, the mesh monolithic
+        layout), so rebuilding the model per request is pure hot-path
+        waste. The memo is read both under the batcher's queue lock
+        (decide_queued) and from handler threads (snapshot), hence its
+        own guard."""
+        # The engine names its own invalidation state (chunk staging,
+        # the wide-k multipass concat, the mesh monolithic layout —
+        # each a resident allocation the floor must follow).
+        state = self.engine.resident_state_key()
         with self._lock:
             cached = self._model_cache
-        if cached is not None and cached[0] == chunks_staged:
+        if cached is not None and cached[0] == state:
             return cached[1]
-        model = memwatch.resident_bytes_model(
-            "serve", capacity_rows=self.engine.capacity_rows,
-            na=self.engine.num_attrs, staging=self.engine._staging,
-            extract_chunks=(self.engine._ex_nchunks
-                            if chunks_staged else 0),
-            chunk_rows=self.engine._ex_chunk_rows)
-        total = int(model["total_bytes"])
+        total = int(self.engine.resident_model_bytes())
         with self._lock:
-            self._model_cache = (chunks_staged, total)
+            self._model_cache = (state, total)
         return total
 
     def headroom_bytes(self) -> Optional[int]:

@@ -87,18 +87,76 @@ class ServeClient:
 
 # -- trace format --------------------------------------------------------------
 
+def validate_trace(header: Dict[str, Any],
+                   requests: List[Dict[str, Any]]) -> List[str]:
+    """Structural validation of a loaded serve trace; returns a list
+    of problems (empty = valid). Beyond the per-line field checks, the
+    ``t_ms`` offsets must be non-negative and MONOTONIC non-decreasing
+    in file order: paced open-loop replay fires requests at their
+    offsets, and a trace whose offsets run backwards would silently
+    reorder the offered-load schedule it claims to encode —
+    ``tools/check_serve_trace.py`` is the CLI gate."""
+    problems: List[str] = []
+    if not isinstance(header, dict):
+        return [f"header must be a JSON object, got "
+                f"{type(header).__name__}"]
+    if header.get("serve_trace_schema") != TRACE_SCHEMA:
+        problems.append(
+            f"header is not serve_trace_schema={TRACE_SCHEMA}")
+    corpus = header.get("corpus")
+    if not isinstance(corpus, dict):
+        problems.append("header carries no corpus block")
+    else:
+        for key in ("num_data", "num_attrs", "min_attr", "max_attr",
+                    "num_labels"):
+            if key not in corpus:
+                problems.append(f"corpus block missing {key!r}")
+    prev_t = None
+    for i, r in enumerate(requests, 1):
+        if not isinstance(r, dict):
+            problems.append(f"request line {i} must be a JSON object")
+            continue
+        if "nq" not in r or "seed" not in r \
+                or ("k" not in r and "ks" not in r):
+            problems.append(f"request line {i} needs nq, seed, and k|ks")
+            continue
+        if not isinstance(r["nq"], int) or r["nq"] < 1:
+            problems.append(f"request line {i}: nq must be a positive "
+                            "int")
+        ks = r.get("ks", [r.get("k")])
+        if not isinstance(ks, list):
+            problems.append(f"request line {i}: 'ks' must be a list")
+        elif not all(isinstance(v, int) and not isinstance(v, bool)
+                     and v >= 1 for v in ks):
+            problems.append(f"request line {i}: k|ks must be positive "
+                            "ints")
+        t = r.get("t_ms")
+        if t is not None:
+            if not isinstance(t, (int, float)) or t < 0:
+                problems.append(f"request line {i}: t_ms must be a "
+                                "non-negative number")
+            elif prev_t is not None and t < prev_t:
+                problems.append(
+                    f"request line {i}: t_ms {t} < previous {prev_t} — "
+                    "offsets must be monotonic non-decreasing")
+            else:
+                prev_t = t
+    return problems
+
+
 def load_trace(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
     with open(path) as f:
         lines = [json.loads(ln) for ln in f if ln.strip()]
-    if not lines or lines[0].get("serve_trace_schema") != TRACE_SCHEMA:
+    if not lines or not isinstance(lines[0], dict) \
+            or lines[0].get("serve_trace_schema") != TRACE_SCHEMA:
         raise ValueError(f"{path}: not a serve_trace_schema="
                          f"{TRACE_SCHEMA} file")
     header, reqs = lines[0], lines[1:]
-    for i, r in enumerate(reqs):
-        if "nq" not in r or "seed" not in r \
-                or ("k" not in r and "ks" not in r):
-            raise ValueError(f"{path}: request line {i + 1} needs "
-                             "nq, seed, and k|ks")
+    problems = validate_trace(header, reqs)
+    if problems:
+        raise ValueError(f"{path}: {problems[0]}"
+                         + (f" (+{len(problems) - 1} more)"
+                            if len(problems) > 1 else ""))
     return header, reqs
 
 
@@ -174,6 +232,67 @@ def replay(port: int, header: Dict[str, Any],
         t.join()
     return [r if r is not None else {"ok": False, "error": "no response"}
             for r in out]
+
+
+def replay_open_loop(port: int, header: Dict[str, Any],
+                     requests: List[Dict[str, Any]], speed: float = 1.0,
+                     host: str = "127.0.0.1",
+                     timeout_s: float = 600.0) -> List[Dict[str, Any]]:
+    """Paced OPEN-LOOP replay: every request fires AT its trace
+    ``t_ms`` offset (divided by ``speed`` — ``speed=2`` offers 2× the
+    trace's load) on its own connection, REGARDLESS of completions —
+    the closed-loop replay's lanes throttle the client to the daemon's
+    pace, which silently caps offered load at achieved load and hides
+    queueing. Here latency is measured from the SCHEDULED fire time,
+    so queue delay (daemon-side and client-side dispatch lag, reported
+    separately as ``lag_ms``) lands in ``client_ms`` — the number a
+    p99-under-offered-load claim is actually about.
+
+    Query payloads are pre-materialized and pre-encoded before the
+    clock starts so the fire loop does no per-request numeric work.
+    Returns one dict per request in trace order: the wire response (or
+    an ``ok: false`` error for connection failures) plus ``client_ms``
+    and ``lag_ms``."""
+    payloads = []
+    for i, req in enumerate(requests):
+        q = materialize_queries(req, header)
+        ks = request_ks(req)
+        obj = {"op": "query", "id": str(i), "queries": q.tolist(),
+               "ks": [int(v) for v in ks]}
+        payloads.append((json.dumps(obj) + "\n").encode())
+    out: List[Optional[Dict[str, Any]]] = [None] * len(requests)
+    t0 = time.monotonic() + 0.05    # small runway so request 0 is paced
+
+    def worker(i: int) -> None:
+        sched = t0 + float(requests[i].get("t_ms", 0)) / 1e3 \
+            / max(speed, 1e-9)
+        delay = sched - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        lag_ms = (time.monotonic() - sched) * 1e3
+        try:
+            with socket.create_connection((host, port),
+                                          timeout=timeout_s) as sock:
+                sock.sendall(payloads[i])
+                with sock.makefile("rb") as rf:
+                    line = rf.readline()
+            if not line:
+                raise ConnectionError("daemon closed the connection")
+            resp = json.loads(line)
+        except (OSError, ValueError) as e:
+            resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        resp["client_ms"] = round((time.monotonic() - sched) * 1e3, 3)
+        resp["lag_ms"] = round(lag_ms, 3)
+        out[i] = resp
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(len(requests))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [r if r is not None
+            else {"ok": False, "error": "no response"} for r in out]
 
 
 def warm_buckets_for_trace(requests: List[Dict[str, Any]],

@@ -119,7 +119,9 @@ class ServeDaemon:
                  telemetry_port: Optional[int] = None,
                  record_path: Optional[str] = None,
                  snapshot_every_s: float = 0.0,
-                 warm_buckets: Optional[List[Tuple[int, int]]] = None):
+                 warm_buckets: Optional[List[Tuple[int, int]]] = None,
+                 mesh_shape: Optional[Tuple[int, int]] = None,
+                 mesh_merge: str = "allgather"):
         self.corpus = corpus
         self.record_path = record_path
         self.snapshot_every_s = snapshot_every_s
@@ -136,9 +138,21 @@ class ServeDaemon:
         # embedding) doesn't inherit the first one's counts and feed
         # inflated requests_per_sec into the ledger.
         telemetry.registry().reset(prefix="serve")
-        self.engine = ResidentEngine(corpus, config or EngineConfig(),
-                                     capacity=capacity,
-                                     gate_carry=gate_carry)
+        if mesh_shape is not None:
+            # Mesh-resident replica: the corpus held sharded-resident
+            # across the mesh (dmlp_tpu.fleet) — same batcher/admission
+            # surface, so everything below is engine-agnostic. Lazy
+            # import: the fleet package layers on serve, not vice versa.
+            from dmlp_tpu.fleet.mesh_engine import MeshResidentEngine
+            self.engine = MeshResidentEngine(
+                corpus, config or EngineConfig(mode="sharded"),
+                mesh_shape=mesh_shape, capacity=capacity,
+                merge=mesh_merge)
+        else:
+            self.engine = ResidentEngine(corpus,
+                                         config or EngineConfig(),
+                                         capacity=capacity,
+                                         gate_carry=gate_carry)
         self.admission = AdmissionController(
             self.engine, budget_bytes=budget_bytes,
             max_queue_queries=max_queue_queries,
@@ -158,13 +172,30 @@ class ServeDaemon:
         self._server_thread: Optional[threading.Thread] = None
         self._t_ready: Optional[float] = None
         self.warmup_ms: Dict[str, float] = {}
+        self._sigterm_prev = None
+        self._sigterm_handler = None
         if self.session is not None:
             self.session.set_sigterm_drain(self._drain_event.set)
         else:
             import signal
+            import weakref
+            # The handler must hold the drain event WEAKLY: a strong
+            # closure over self registered in the signal module would
+            # pin this daemon's engine — resident device buffers
+            # included — for the process lifetime (several daemons per
+            # process tear down in arbitrary order, so prev-handler
+            # restoration alone cannot unpin), silently inflating the
+            # live-array watermark every later admission decision reads.
+            ev_ref = weakref.ref(self._drain_event)
+
+            def _on_sigterm(signum, frame, _ev_ref=ev_ref):
+                ev = _ev_ref()
+                if ev is not None:
+                    ev.set()
             try:
-                signal.signal(signal.SIGTERM,
-                              lambda s, f: self._drain_event.set())
+                self._sigterm_prev = signal.signal(signal.SIGTERM,
+                                                   _on_sigterm)
+                self._sigterm_handler = _on_sigterm
             except ValueError:
                 pass    # not the main thread (tests): drain op only
 
@@ -293,7 +324,8 @@ class ServeDaemon:
                     "capacity_rows": eng.capacity_rows,
                     "num_attrs": eng.num_attrs,
                     "gate_carry": eng.gate_carry,
-                    "mode": "resident",
+                    "mode": ("mesh_resident" if hasattr(eng, "mesh")
+                             else "resident"),
                     "buckets": eng.bucket_stats()["buckets"]},
             metrics=metrics, device=current_device())
 
@@ -317,6 +349,22 @@ class ServeDaemon:
                 next_snap = time.monotonic() + self.snapshot_every_s
         self.drain()
 
+    def _restore_sigterm(self) -> None:
+        """Undo the SIGTERM hook — only when it is still OURS (another
+        daemon may have registered over us; clobbering its handler
+        would break that daemon's drain)."""
+        if self._sigterm_handler is None:
+            return
+        import signal
+        try:
+            if signal.getsignal(signal.SIGTERM) is self._sigterm_handler:
+                signal.signal(signal.SIGTERM,
+                              self._sigterm_prev or signal.SIG_DFL)
+        except ValueError:
+            pass
+        self._sigterm_handler = None
+        self._sigterm_prev = None
+
     def drain(self) -> None:
         """The orderly shutdown: shed new work, finish queued work,
         flush records + final telemetry snapshot, close. No flight
@@ -333,6 +381,7 @@ class ServeDaemon:
         if self.session is not None:
             self.session.set_sigterm_drain(None)
             self.session.close()     # writes the final snapshot
+        self._restore_sigterm()
         self._server.server_close()
 
     def close(self) -> None:
@@ -344,4 +393,5 @@ class ServeDaemon:
         if self.session is not None:
             self.session.set_sigterm_drain(None)
             self.session.close()
+        self._restore_sigterm()
         self._server.server_close()

@@ -2,8 +2,8 @@
 
 :class:`ResidentEngine` is the serving daemon's solve core. It differs
 from the batch :class:`~dmlp_tpu.engine.single.SingleChipEngine` in
-exactly the three ways a persistent server needs, and nowhere else —
-the candidates -> host-float64 finalize -> boundary-hazard repair
+exactly the ways a persistent server needs, and nowhere else — the
+candidates -> host-float64 finalize -> boundary-hazard repair
 pipeline (the byte-identity contract with the golden oracle) is
 inherited unchanged:
 
@@ -35,6 +35,13 @@ inherited unchanged:
   the fold is sound in any order, and the boundary-hazard repair makes
   ties at the candidate boundary exact either way — carry on and off
   are byte-identical by construction (and proven in the A/B).
+- **Wide-k multipass buckets.** A k-bucket whose candidate width
+  exceeds the extraction kernel's single-pass window routes through
+  the batch engine's multi-pass extraction driver AGAINST THE RESIDENT
+  CHUNKS (:meth:`ResidentEngine._solve_resident_multipass`): no
+  staging per request, floor-chained resident re-sweeps over a cached
+  concatenation, and the driver's stall/shortfall hazards feed run()'s
+  exact repair — byte-identical to the solo multipass solve.
 """
 
 from __future__ import annotations
@@ -51,8 +58,8 @@ from dmlp_tpu.config import EngineConfig
 from dmlp_tpu.engine.single import (_BF16_AUTO_K_CAP, ChunkThrottle,
                                     SingleChipEngine, _extract_finalize,
                                     _topk_blocks, fit_blocks, np_staging_dtype,
-                                    plan_chunks, resolve_kcap, round_up,
-                                    stage_put)
+                                    plan_chunks, resilient_get, resolve_kcap,
+                                    round_up, stage_put)
 from dmlp_tpu.io.grammar import KNNInput, Params
 from dmlp_tpu.io.report import QueryResult
 from dmlp_tpu.obs import telemetry
@@ -105,7 +112,7 @@ class _Bucket:
     def __init__(self, qpad: int, kb: int, kcap: int, path: str,
                  qb: int, nqb: int):
         self.qpad, self.kb, self.kcap = qpad, kb, kcap
-        self.path = path          # "extract" | "stream"
+        self.path = path          # "extract" | "multipass" | "stream"
         self.qb, self.nqb = qb, nqb
         self.stream = None        # AOT-compiled _topk_blocks, when built
 
@@ -114,7 +121,117 @@ class _Bucket:
         return f"q{self.qpad}k{self.kb}"
 
 
-class ResidentEngine(SingleChipEngine):
+class ResidentServingCore:
+    """The serving surface shared by BOTH resident engines (the
+    single-chip :class:`ResidentEngine` and the mesh
+    :class:`~dmlp_tpu.fleet.mesh_engine.MeshResidentEngine`):
+    compile-once bucket bookkeeping, warm-up, the corpus max-sq-norm
+    cache, and the memory-model hooks the admission controller and
+    obs.memwatch read. Single-sourced here so a fix to any of them
+    cannot silently miss the other engine.
+
+    Subclass contract: ``bucket_shape``/``_build_bucket``/``max_k``/
+    ``solve_batch`` plus the resident state the hooks read; the
+    subclass implements :meth:`mem_model` (its analytic per-device
+    model, batch terms included iff ``nq > 0``) and
+    :meth:`batch_model_bytes` (the marginal per-batch terms — the
+    term names differ per model), and names its cache-invalidation
+    state in :meth:`resident_state_key`.
+    """
+
+    def _bucket_entry(self, nq: int, kmax: int):
+        """The bucket for (nq, kmax), building (and counting) it on
+        first use — warm-up pre-drives this so steady-state serving
+        takes the dict hit only."""
+        if kmax > self.max_k:
+            raise RequestShapeError(
+                f"k={kmax} beyond the serving cap {self.max_k}")
+        key = self.bucket_shape(nq, kmax)
+        entry = self._buckets.get(key)
+        if entry is None:
+            t0 = time.perf_counter()
+            entry = self._build_bucket(*key)
+            self._buckets[key] = entry
+            ms = (time.perf_counter() - t0) * 1e3
+            self.bucket_compile_ms[entry.key] = round(ms, 3)
+            self.compile_count += 1
+            reg = telemetry.registry()
+            reg.counter("serve.bucket_compiles").inc(label=entry.key)
+            reg.histogram("serve.bucket_compile_ms", unit="ms").observe(ms)
+        return entry
+
+    def warmup(self, buckets) -> Dict[str, float]:
+        """Drive one synthetic micro-batch through every (nq, k) in
+        ``buckets`` BEFORE serving: compiles the bucket programs and
+        the shared epilogue jits, and records
+        ``serve.cold_start_compile_ms`` — the startup SLO is a number,
+        not a hope. Returns per-bucket wall ms."""
+        t0 = time.perf_counter()
+        per: Dict[str, float] = {}
+        seen = set()
+        for nq, k in buckets:
+            # Clamp to the serving cap ONLY — k > n_real is a legal
+            # request shape (sentinel padding, golden-identical), so a
+            # requested warm bucket above the corpus row count must
+            # warm THAT k-bucket, not silently a smaller one.
+            k = max(1, min(int(k), self.max_k))
+            nq = max(1, int(nq))
+            key = self.bucket_shape(nq, k)
+            if key in seen:
+                continue
+            seen.add(key)
+            tb = time.perf_counter()
+            idx = np.arange(nq) % self.n_real
+            q = self._host_attrs[:self.n_real][idx]
+            ks = np.full(nq, k, np.int32)
+            with obs_span("serve.warmup_bucket", qpad=key[0], kb=key[1]):
+                self.solve_batch(q, ks)
+            per[f"q{key[0]}k{key[1]}"] = round(
+                (time.perf_counter() - tb) * 1e3, 3)
+        self.cold_start_compile_ms = round(
+            (time.perf_counter() - t0) * 1e3, 3)
+        reg = telemetry.registry()
+        reg.gauge("serve.cold_start_compile_ms").set(
+            self.cold_start_compile_ms)
+        reg.gauge("serve.warm_buckets").set(len(self._buckets))
+        return per
+
+    # -- corpus max squared norm (boundary-eps / multipass floors) ----------
+
+    def _dn_max(self) -> float:
+        if self._dn_max_cache is None:
+            a = self._host_attrs[:self.n_real]
+            self._dn_max_cache = float(
+                np.einsum("na,na->n", a, a).max()) if self.n_real \
+                else 0.0
+        return self._dn_max_cache
+
+    def _note_ingested_norms(self, attrs: np.ndarray) -> None:
+        """Append-only ingest keeps the cache incremental: the max
+        squared norm only grows."""
+        if self._dn_max_cache is not None and len(attrs):
+            nn = np.einsum("ma,ma->m", attrs, attrs).max()
+            self._dn_max_cache = max(self._dn_max_cache, float(nn))
+
+    # -- memory-model hooks (admission + memwatch read these) ---------------
+
+    def mem_model(self, nq: int = 0, kmax: int = 0):
+        raise NotImplementedError
+
+    def batch_model_bytes(self, nq: int, kmax: int) -> int:
+        raise NotImplementedError
+
+    def resident_state_key(self):
+        """The resident-state tuple whose change invalidates a cached
+        resident-floor total (admission memoizes on it)."""
+        raise NotImplementedError
+
+    def resident_model_bytes(self) -> int:
+        """Per-device resident floor (corpus terms only, no batch)."""
+        return int(self.mem_model(0, 0)["total_bytes"])
+
+
+class ResidentEngine(ResidentServingCore, SingleChipEngine):
     """Compile-once resident engine for the serving daemon.
 
     ``corpus`` supplies the data side (its query section, if any, is
@@ -188,6 +305,11 @@ class ResidentEngine(SingleChipEngine):
         self.compile_count = 0
         self.cold_start_compile_ms: Optional[float] = None
         self.bucket_compile_ms: Dict[str, float] = {}
+        # Wide-k multipass residency: the concatenated resident chunks
+        # (passes 2+ re-sweep it whole) and the corpus max-sq-norm the
+        # floor chain scales by — both invalidated/updated on ingest.
+        self._mp_full = None
+        self._dn_max_cache: Optional[float] = None
         # Cross-request gate state: per-chunk winner histogram + last
         # batch's gated-tile stats (pending device scalar, tile count).
         self._block_hits = np.zeros(max(self._ex_nchunks, 1), np.int64)
@@ -241,27 +363,6 @@ class ResidentEngine(SingleChipEngine):
         qpad, kb = self.bucket_shape(nq, kmax)
         return qpad, kb, self._kcap_for(kb)
 
-    def _bucket_entry(self, nq: int, kmax: int) -> _Bucket:
-        """The bucket for (nq, kmax), building (and counting) it on
-        first use — warm-up pre-drives this so steady-state serving
-        takes the dict hit only."""
-        if kmax > self.max_k:
-            raise RequestShapeError(
-                f"k={kmax} beyond the serving cap {self.max_k}")
-        key = self.bucket_shape(nq, kmax)
-        entry = self._buckets.get(key)
-        if entry is None:
-            t0 = time.perf_counter()
-            entry = self._build_bucket(*key)
-            self._buckets[key] = entry
-            ms = (time.perf_counter() - t0) * 1e3
-            self.bucket_compile_ms[entry.key] = round(ms, 3)
-            self.compile_count += 1
-            reg = telemetry.registry()
-            reg.counter("serve.bucket_compiles").inc(label=entry.key)
-            reg.histogram("serve.bucket_compile_ms", unit="ms").observe(ms)
-        return entry
-
     def _build_bucket(self, qpad: int, kb: int) -> _Bucket:
         cfg = self.config
         kcap = self._kcap_for(kb)
@@ -275,6 +376,17 @@ class ResidentEngine(SingleChipEngine):
                 qpad, self._ex_chunk_rows, self.num_attrs, kcap)
             if kern is not None:
                 path = "extract"
+                self._ensure_chunks()
+        elif self._extract_ok and kcap > self._MP_KC \
+                and -(-kcap // self._MP_KC) <= self._MP_MAX_PASSES:
+            # Wide-k serving (ROADMAP item (d)): kcap past the kernel's
+            # single-pass window routes through the multi-pass
+            # extraction driver against the RESIDENT chunks.
+            from dmlp_tpu.ops import pallas_fused
+            kern, _ = pallas_fused.resolve_topk_kernel(
+                qpad, self._ex_chunk_rows, self.num_attrs, self._MP_KC)
+            if kern is not None:
+                path = "multipass"
                 self._ensure_chunks()
         entry = _Bucket(qpad, kb, kcap, path, qb, nqb)
         if path == "stream":
@@ -437,6 +549,11 @@ class ResidentEngine(SingleChipEngine):
                 # rebuild with the rows — a stale summary could keep a
                 # block pruned whose NEW rows belong in a top-k.
                 self._rebuild_summary_blocks(touched)
+                # Wide-k residency: the cached chunk concatenation is
+                # stale the moment a chunk restages (same shapes, so
+                # the rebuild never recompiles).
+                self._mp_full = None
+            self._note_ingested_norms(attrs)
         reg = telemetry.registry()
         reg.counter("serve.ingested_rows").inc(m)
         reg.gauge("serve.corpus_rows").set(new_n)
@@ -590,6 +707,118 @@ class ResidentEngine(SingleChipEngine):
         top = _extract_finalize(od, oi, self._d_labels, k=entry.kcap)
         return top, entry.qpad
 
+    # -- wide-k multipass serving (ROADMAP item (d)) --------------------------
+
+    def _resident_full(self):
+        """The resident chunks as ONE device array for the multipass
+        resident sweeps — concatenated lazily, cached across requests,
+        invalidated on ingest. Same shapes every rebuild, so the concat
+        compiles once (covered by the wide bucket's warm-up)."""
+        if self._mp_full is None:
+            self._mp_full = self._chunks[0] if self._ex_nchunks == 1 \
+                else jnp.concatenate(self._chunks, axis=0)
+        return self._mp_full
+
+    def _solve_resident_multipass(self, inp: KNNInput, entry: _Bucket
+                                  ) -> Optional[Tuple[TopK, int]]:
+        """k past the kernel's single-pass window, served on the
+        existing multi-pass extraction driver (engine.single
+        ._solve_extract_multipass) against the RESIDENT chunks: pass 1
+        folds the resident chunk buffers (no staging), passes 2+
+        re-sweep the cached resident concatenation with the on-device
+        floor chain (``_mp_floor``), and ``_mp_merge`` dedups and
+        composite-sorts to the bucket width. The driver's two loss
+        modes (tie-plateau stall / eps-window shortfall) set
+        ``_mp_hazard`` exactly like the batch engine, and run()'s
+        boundary repair makes them exact — byte-identical to the solo
+        multipass solve and the golden oracle."""
+        from dmlp_tpu.engine.single import _mp_floor, _mp_merge
+        from dmlp_tpu.ops import pallas_fused
+        from dmlp_tpu.ops.summaries import note_scan
+        kc = self._MP_KC
+        kcap = entry.kcap
+        if self._chunks is None or -(-kcap // kc) > self._MP_MAX_PASSES:
+            return None
+        kern, impl = pallas_fused.resolve_topk_kernel(
+            entry.qpad, self._ex_chunk_rows, self.num_attrs, kc,
+            rung=self._degrade_rung)
+        if kern is None:
+            return None
+        full_rows = self._ex_nchunks * self._ex_chunk_rows
+        kern_full, _impl_full = pallas_fused.resolve_topk_kernel(
+            entry.qpad, full_rows, self.num_attrs, kc,
+            rung=self._degrade_rung)
+        if kern_full is None:
+            return None
+        npasses = -(-kcap // kc)
+        nq = inp.params.num_queries
+        na = self.num_attrs
+        n = self.n_real
+        cr = self._ex_chunk_rows
+        q = np.zeros((entry.qpad, na), np.float32)
+        q[:nq] = inp.query_attrs
+        q_dev = stage_put(q, self._staging)
+        self._last_select = "extract"
+        self.last_extract_impl = impl
+        od = oi = None
+        throttle = ChunkThrottle()
+        with obs_span("serve.solve_multipass", qpad=entry.qpad,
+                      kcap=kcap, passes=npasses, impl=impl):
+            for c in range(self._ex_nchunks):
+                lo = c * cr
+                nr = min(n - lo, cr)
+                if nr <= 0:
+                    continue
+                od, oi, _its = kern(q_dev, self._chunks[c], od, oi,
+                                    n_real=nr, id_base=lo, kc=kc,
+                                    interpret=self._interpret)
+                throttle.tick(od)
+                telemetry.sample_memory_now()
+            if od is None:
+                return None
+            ods, ois = [od], [oi]
+            qn_host = np.zeros(entry.qpad, np.float64)
+            qn_host[:nq] = np.einsum("qa,qa->q", inp.query_attrs,
+                                     inp.query_attrs)
+            qn_dev = jax.device_put(np.asarray(qn_host, np.float32))
+            dn_dev = jax.device_put(np.float32(self._dn_max()))
+            d_full = self._resident_full()
+            fds = []
+            for _p in range(1, npasses):
+                floor_dev, fd = _mp_floor(ods[-1], qn_dev, dn_dev,
+                                          staging=self._staging, na=na)
+                fds.append(fd)
+                od, oi, _its = kern_full(q_dev, d_full, n_real=n,
+                                         id_base=0, kc=kc,
+                                         interpret=self._interpret,
+                                         floor=floor_dev)
+                throttle.tick(od)
+                ods.append(od)
+                ois.append(oi)
+            fds.append(_mp_floor(ods[-1], qn_dev, dn_dev,
+                                 staging=self._staging, na=na)[1])
+            top, valid = _mp_merge(jnp.concatenate(ods, axis=1),
+                                   jnp.concatenate(ois, axis=1),
+                                   self._d_labels, kcap=kcap)
+        self.last_mp_passes = len(ods)
+        # The multipass plan re-sweeps the whole resident corpus: a
+        # dense scan by design, staged bytes counted once.
+        dense = n * na * self._staging_itemsize()
+        note_scan(self, scanned_bytes=dense, dense_bytes=dense,
+                  blocks_total=self._ex_nchunks, blocks_pruned=0)
+        # One fence: fd chain (stall check) + final valid counts
+        # (shortfall check) — run()'s repair makes both exact.
+        fetched = resilient_get([valid] + fds)
+        valid_h, fd_h = fetched[0], fetched[1:]
+        stalled = np.zeros(entry.qpad, bool)
+        for prev, cur in zip(fd_h, fd_h[1:]):
+            stalled |= np.isfinite(cur) & (cur <= prev)
+        needed = np.minimum(inp.ks.astype(np.int64), n)
+        shortfall = np.asarray(valid_h)[:nq] < needed
+        self._mp_hazard = stalled[:nq] | shortfall
+        telemetry.registry().counter("serve.multipass_batches").inc()
+        return top, entry.qpad
+
     def _chunk_order(self) -> List[int]:
         """Fold order over the resident chunks: hottest (most past
         winners) first when gate carry-over is on, natural otherwise.
@@ -619,12 +848,17 @@ class ResidentEngine(SingleChipEngine):
             out = self._solve_resident_extract(inp, entry)
             if out is not None:
                 return out
+        if entry.path == "multipass" and self._degrade_rung != "streaming":
+            out = self._solve_resident_multipass(inp, entry)
+            if out is not None:
+                return out
         return self._solve_resident_stream(inp, entry)
 
     def _solve_segments(self, inp: KNNInput, allow_multipass: bool = True):
-        # No hetk routing and no multipass on the resident paths: the
-        # serving cap keeps every k single-pass, and one segment per
-        # micro-batch keeps the per-request slicing trivial.
+        # No hetk routing on the resident paths: one segment per
+        # micro-batch keeps the per-request slicing trivial. Wide-k
+        # buckets route through _solve_resident_multipass inside
+        # _solve (which sets _mp_hazard for run()'s exact repair).
         self.last_hetk = None
         self._mp_hazard = None
         self.last_mp_passes = 0
@@ -678,44 +912,34 @@ class ResidentEngine(SingleChipEngine):
                                    minlength=self._ex_nchunks)
                 self._block_hits[:len(hits)] += hits
 
-    # -- warm-up (the cold-start satellite) -----------------------------------
+    # -- memory-model hooks (ResidentServingCore contract) --------------------
 
-    def warmup(self, buckets) -> Dict[str, float]:
-        """Drive one synthetic micro-batch through every (nq, k) in
-        ``buckets`` BEFORE serving: compiles the bucket programs (AOT
-        for streaming, first-dispatch for the extract kernels) and the
-        shared epilogue jits, and records
-        ``serve.cold_start_compile_ms`` — the startup SLO is a number,
-        not a hope. Returns per-bucket wall ms."""
-        t0 = time.perf_counter()
-        per: Dict[str, float] = {}
-        seen = set()
-        for nq, k in buckets:
-            # Clamp to the serving cap ONLY — k > n_real is a legal
-            # request shape (sentinel padding, golden-identical), so a
-            # requested warm bucket above the corpus row count must
-            # warm THAT k-bucket, not silently a smaller one.
-            k = max(1, min(int(k), self.max_k))
-            nq = max(1, int(nq))
-            key = self.bucket_shape(nq, k)
-            if key in seen:
-                continue
-            seen.add(key)
-            tb = time.perf_counter()
-            idx = np.arange(nq) % self.n_real
-            q = self._host_attrs[:self.n_real][idx]
-            ks = np.full(nq, k, np.int32)
-            with obs_span("serve.warmup_bucket", qpad=key[0], kb=key[1]):
-                self.solve_batch(q, ks)
-            per[f"q{key[0]}k{key[1]}"] = round(
-                (time.perf_counter() - tb) * 1e3, 3)
-        self.cold_start_compile_ms = round(
-            (time.perf_counter() - t0) * 1e3, 3)
-        reg = telemetry.registry()
-        reg.gauge("serve.cold_start_compile_ms").set(
-            self.cold_start_compile_ms)
-        reg.gauge("serve.warm_buckets").set(len(self._buckets))
-        return per
+    def mem_model(self, nq: int = 0, kmax: int = 0):
+        """The analytic per-device model at this engine's OWN
+        bucket_plan (the one kcap derivation — no drift between model
+        and solve); batch terms included iff ``nq > 0``."""
+        from dmlp_tpu.obs import memwatch
+        qpad = kcap = 0
+        if nq > 0:
+            qpad, _kb, kcap = self.bucket_plan(nq, max(kmax, 1))
+        return memwatch.serve_engine_model(
+            self.capacity_rows, self.num_attrs, staging=self._staging,
+            qpad=qpad, kcap=kcap,
+            extract_chunks=(self._ex_nchunks if self._chunks else 0),
+            chunk_rows=self._ex_chunk_rows,
+            summary_blocks=(self._ex_nchunks
+                            if self._summ_dev is not None else 0),
+            multipass_rows=(self._ex_nchunks * self._ex_chunk_rows
+                            if self._mp_full is not None else 0))
+
+    def batch_model_bytes(self, nq: int, kmax: int) -> int:
+        terms = self.mem_model(nq, kmax)["terms"]
+        return int(terms["query_blocks"] + terms["topk_carries"])
+
+    def resident_state_key(self):
+        # The floor moves when the extract chunks stage and when the
+        # wide-k multipass concat materializes (a SECOND corpus copy).
+        return (self._chunks is not None, self._mp_full is not None)
 
     # -- introspection --------------------------------------------------------
 

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Validate a serve trace file (serve_trace_schema 1).
+
+The check_trace-style gate for the replay/SLO harness: structural
+field checks plus the paced-replay contract — ``t_ms`` offsets must be
+non-negative and MONOTONIC non-decreasing (open-loop replay fires
+requests at their offsets; a backwards offset would silently reorder
+the offered-load schedule the trace claims to encode). Exit 0 when
+valid, 1 with the problems named otherwise.
+
+Usage::
+
+    python tools/check_serve_trace.py inputs/serve_trace2.jsonl [--json]
+
+``--json`` prints a pure-JSON verdict on stdout (narration to stderr),
+following the tools/check_trace.py convention.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dmlp_tpu.serve import client as sc  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", help="serve trace JSONL file")
+    ap.add_argument("--json", action="store_true",
+                    help="pure-JSON verdict on stdout")
+    args = ap.parse_args(argv)
+
+    problems = []
+    requests = 0
+    span_ms = None
+    try:
+        # Parse leniently here (load_trace itself now raises on the
+        # problems this tool exists to REPORT).
+        with open(args.trace) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        if not lines:
+            problems.append("empty file")
+            header, reqs = {}, []
+        else:
+            header, reqs = lines[0], lines[1:]
+            problems.extend(sc.validate_trace(header, reqs))
+            requests = len(reqs)
+            ts = [r["t_ms"] for r in reqs if isinstance(r, dict)
+                  and isinstance(r.get("t_ms"), (int, float))]
+            span_ms = max(ts) if ts else None
+    except (OSError, ValueError) as e:
+        problems.append(f"unreadable: {e}")
+
+    verdict = {
+        "trace": args.trace,
+        "valid": not problems,
+        "requests": requests,
+        "span_ms": span_ms,
+        "problems": problems,
+    }
+    if args.json:
+        print(json.dumps(verdict, sort_keys=True))
+        out = sys.stderr
+    else:
+        out = sys.stdout
+    if problems:
+        print(f"check_serve_trace: INVALID {args.trace}:", file=out)
+        for p in problems[:10]:
+            print(f"  - {p}", file=out)
+        return 1
+    print(f"check_serve_trace: OK {args.trace} ({requests} requests"
+          + (f", span {span_ms} ms" if span_ms is not None else "")
+          + ")", file=out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

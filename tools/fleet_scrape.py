@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Aggregate per-replica OpenMetrics scrapes into one fleet snapshot.
+
+Counters sum, log-bucket histograms merge bucket-wise, gauges keep
+per-replica ``{replica="..."}`` labels (dmlp_tpu.fleet.scrape); the
+merged exposition is validated with
+``obs.telemetry.validate_openmetrics`` before it is written — an
+invalid aggregate exits 1.
+
+Usage::
+
+    python tools/fleet_scrape.py SRC [SRC ...] [--names N,N]
+        [--out FILE] [--json]
+
+``SRC`` is an ``http://host:port/metrics`` URL (live scrape) or a
+snapshot file path (the daemon's ``--telemetry`` output). ``--json``
+prints a pure-JSON verdict on stdout (narration to stderr), following
+the tools/check_trace.py convention.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dmlp_tpu.fleet import scrape as fscrape          # noqa: E402
+from dmlp_tpu.obs.telemetry import validate_openmetrics  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("sources", nargs="+",
+                    help="per-replica /metrics URLs or snapshot files")
+    ap.add_argument("--names", default=None,
+                    help="comma-separated replica names (default r0..)")
+    ap.add_argument("--out", default=None,
+                    help="write the merged exposition here")
+    ap.add_argument("--json", action="store_true",
+                    help="pure-JSON verdict on stdout")
+    args = ap.parse_args(argv)
+
+    names = args.names.split(",") if args.names else None
+    if names and len(names) != len(args.sources):
+        print("fleet_scrape: --names needs one name per source",
+              file=sys.stderr)
+        return 2
+    merged, problems = fscrape.fleet_view(args.sources,
+                                          replica_names=names)
+    errors = validate_openmetrics(merged)
+    verdict = {
+        "sources": args.sources,
+        "valid": not errors,
+        "problems": problems,
+        "validation_errors": errors,
+        "families": sum(1 for ln in merged.splitlines()
+                        if ln.startswith("# TYPE ")),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(merged)
+        verdict["out"] = args.out
+    narrate = sys.stderr if args.json else sys.stdout
+    if args.json:
+        print(json.dumps(verdict, sort_keys=True))
+    elif not args.out:
+        sys.stdout.write(merged)
+    for p in problems[:5]:
+        print(f"fleet_scrape: note: {p}", file=narrate)
+    if errors:
+        print(f"fleet_scrape: INVALID merged exposition: {errors[:3]}",
+              file=narrate)
+        return 1
+    print(f"fleet_scrape: OK: {verdict['families']} families from "
+          f"{len(args.sources)} sources"
+          + (f" -> {args.out}" if args.out else ""), file=narrate)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
