@@ -7,7 +7,8 @@ CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
 .PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
 	perf-gate check lint chaos-smoke telemetry-smoke serve-smoke \
-	race-smoke prune-smoke fleet-smoke serve-bench fleet-bench clean
+	race-smoke prune-smoke fleet-smoke fleet-chaos-smoke serve-bench \
+	fleet-bench clean
 
 all: native
 
@@ -18,7 +19,7 @@ native/_fastparse.so: native/fastparse.cpp
 
 test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint \
 	chaos-smoke telemetry-smoke serve-smoke race-smoke prune-smoke \
-	fleet-smoke
+	fleet-smoke fleet-chaos-smoke
 	python -m pytest tests/ -q
 
 # Static analysis + runtime-sanitizer smoke (README "Static analysis &
@@ -239,6 +240,33 @@ fleet-smoke:
 	rm -f outputs/fleet/FLEET_SMOKE.jsonl
 	JAX_PLATFORMS=cpu python tools/fleet_smoke.py --out outputs/fleet \
 	  --record outputs/fleet/FLEET_SMOKE.jsonl
+
+# Self-healing-fleet chaos smoke (README "Fleet self-healing"): three
+# seeded failure campaigns over REAL fleets on CPU, every served
+# response byte-identical to the golden oracle throughout. (1) A
+# SUPERVISED fleet (the router spawns/owns 2 mesh-2x1 replicas): one
+# replica SIGKILLed mid-replay — every in-flight response still golden
+# via bounded retry, the supervisor detects the death and relaunches
+# within its budget, the revived fleet serves golden. (2) Far-row
+# ingest pushes both replicas past the capacity-buffer threshold while
+# open-loop traffic keeps firing: the supervisor stages one shard
+# re-split at a time (grown-layout replacement, checksum-verified
+# corpus replay, routing-table swap, old replica drained rc 0) until
+# the whole fleet runs the doubled capacity — zero lost responses,
+# post-split replay golden on the grown corpus. (3) A seeded
+# serve.ingest transient fault (the PR 7 injection machinery) drops
+# one replica's ingest: the router reports the divergence, the health
+# prober's corpus-checksum comparison detects it, and the targeted
+# delta re-ingest repairs it — counters non-vacuous, repaired fleet
+# golden, every process exits 0, no flight dumps. The chaos RunRecords
+# round-trip the perf ledger as gated fleet/chaos_*/ series
+# (FLEET_CHAOS_r15.jsonl is the committed round).
+fleet-chaos-smoke:
+	mkdir -p outputs/fleet_chaos
+	rm -f outputs/fleet_chaos/FLEET_CHAOS_SMOKE.jsonl
+	JAX_PLATFORMS=cpu python tools/fleet_chaos_smoke.py \
+	  --out outputs/fleet_chaos \
+	  --record outputs/fleet_chaos/FLEET_CHAOS_SMOKE.jsonl
 
 # Fleet SLO bench (not in `make test`; emits the FLEET_rNN ledger
 # rounds): 2 replicas (one mesh-resident) + router, the paced trace

@@ -31,10 +31,29 @@ the ROADMAP calls for:
   delay lands in the measured latency), swept over offered-load
   multipliers into ledger-gated ``fleet/<level>/...`` RunRecords — the
   p99-under-offered-load curve, not just closed-loop throughput.
+- :mod:`dmlp_tpu.fleet.autoscale` — the self-healing half's lifecycle
+  owner: a router-side supervisor that spawns/retires replica daemons
+  against the probed load, detects crashed/hung replicas
+  (process-exit + probe-dead deadline), relaunches within a bounded
+  budget, and degrades to a smaller fleet when it runs out.
+- :mod:`dmlp_tpu.fleet.reshard` — the staged shard re-split: when
+  ingest approaches a replica's capacity-padded buffer limit, a
+  grown-layout replacement is spawned, the corpus replayed into it
+  checksum-verified, the routing table swapped, and the old replica
+  drained — growth past the fixed resident layout with zero dropped
+  or wrong responses.
+- :mod:`dmlp_tpu.fleet.consistency` — checksum-driven repair: rolling
+  per-engine corpus signatures (layout-independent), divergence
+  diagnosis across replicas, and targeted delta re-ingest via
+  idempotent global-row-id-keyed writes; unrepairable divergence
+  escalates to quarantine.
 
-``python -m dmlp_tpu.fleet`` runs the router (see
-:mod:`dmlp_tpu.fleet.__main__`); ``make fleet-smoke`` proves the whole
-stack end to end against the golden oracle.
+``python -m dmlp_tpu.fleet`` runs the router — static over existing
+replicas, or SUPERVISED (``--spawn-corpus``) where it owns the whole
+replica lifecycle (see :mod:`dmlp_tpu.fleet.__main__`); ``make
+fleet-smoke`` proves the serving stack end to end against the golden
+oracle and ``make fleet-chaos-smoke`` proves the self-healing under
+seeded kills, injected ingest divergence, and a forced re-split.
 """
 
 # Same early racecheck hook as dmlp_tpu.serve: `python -m dmlp_tpu.fleet`

@@ -1,21 +1,39 @@
 """``python -m dmlp_tpu.fleet`` — the fleet front-end router CLI.
 
-Usage::
+Two modes:
+
+**Static** (PR 14): route over an existing replica set::
 
     python -m dmlp_tpu.fleet --replicas H:P,H:P[,...]
         [--scrape-ports Q,Q,...] [--port 0] [--ready-file PATH]
         [--telemetry-port PORT] [--record FILE]
         [--health-interval-s S] [--request-timeout-s S]
+        [--revive-probes N] [--repair on|off]
 
-Fans the daemon wire protocol (queries load-balanced with bounded
-retry-on-replica-failure, ingest fanned out to every replica, stats
-aggregated) across the given daemon replicas; ``--telemetry-port``
-serves the merged fleet OpenMetrics view (per-replica scrapes +
-router counters). Prints ``dmlp_tpu.fleet: ready port=P replicas=N``
-on stderr (and writes ``--ready-file``), then routes until SIGTERM or
-an in-band ``drain`` op — which propagates the drain to every replica,
-finishes in-flight relays, appends the final fleet RunRecord, and
-exits 0.
+**Supervised** (the self-healing fleet): the router SPAWNS and owns its
+replicas — crash detection with bounded relaunch, load-driven
+auto-scaling between ``--min-replicas`` and ``--max-replicas``, and
+the staged shard re-split when ingest approaches a replica's capacity
+buffer::
+
+    python -m dmlp_tpu.fleet --spawn-corpus FILE
+        [--spawn-replicas N] [--max-replicas N] [--out-dir DIR]
+        [--spawn-warm NQxK,...] [--spawn-batch-cap N]
+        [--spawn-flags "--mesh 2x1 ..."] [--spawn-capacity ROWS]
+        [--relaunch-budget N] [--reshard-threshold F]
+        [--scale-high F] [--scale-low F] [--poll-s S] ...
+
+Either way the router fans the daemon wire protocol (queries
+load-balanced with bounded retry-on-replica-failure and revive
+hysteresis, ingest fanned out to every replica with checksum-driven
+consistency repair, stats aggregated) across the replicas;
+``--telemetry-port`` serves the merged fleet OpenMetrics view with
+per-replica scrape-freshness gauges. Prints ``dmlp_tpu.fleet: ready
+port=P replicas=N`` on stderr (and writes ``--ready-file``), then
+routes until SIGTERM or an in-band ``drain`` op — which stops the
+supervisor FIRST (no relaunch storm), propagates the drain to every
+replica, finishes in-flight relays, appends the final fleet RunRecord,
+and exits 0 only when every managed replica also exited 0.
 """
 
 from __future__ import annotations
@@ -23,6 +41,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shlex
 import signal
 import sys
 from typing import List, Optional, Sequence, Tuple
@@ -61,8 +80,9 @@ def _parse_ports(spec: Optional[str], n: int) -> List[Optional[int]]:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="dmlp_tpu.fleet",
                                 description=__doc__)
-    p.add_argument("--replicas", required=True, metavar="H:P,H:P",
-                   help="daemon replica endpoints to fan across")
+    p.add_argument("--replicas", default=None, metavar="H:P,H:P",
+                   help="existing daemon replica endpoints (static "
+                        "mode; omit with --spawn-corpus)")
     p.add_argument("--scrape-ports", default=None, metavar="Q,Q",
                    help="per-replica telemetry ports for the "
                         "aggregated fleet scrape ('-' skips one)")
@@ -77,6 +97,44 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="append the final fleet-router RunRecord here")
     p.add_argument("--health-interval-s", type=float, default=1.0)
     p.add_argument("--request-timeout-s", type=float, default=600.0)
+    p.add_argument("--revive-probes", type=int, default=1,
+                   help="consecutive healthy probes before a marked-"
+                        "down replica routes again (flap hysteresis)")
+    p.add_argument("--repair", choices=["on", "off"], default="on",
+                   help="checksum-driven consistency repair of "
+                        "divergent replicas (targeted delta re-ingest)")
+    # -- supervised mode -------------------------------------------------------
+    p.add_argument("--spawn-corpus", metavar="FILE", default=None,
+                   help="supervise mode: spawn replicas over this "
+                        "corpus file instead of fanning over --replicas")
+    p.add_argument("--spawn-replicas", type=int, default=2,
+                   help="initial (and minimum) supervised fleet size")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help="auto-scaling ceiling (default: initial + 2)")
+    p.add_argument("--out-dir", metavar="DIR", default=".",
+                   help="supervised replicas' scratch dir (ready "
+                        "files, telemetry snapshots, stderr logs)")
+    p.add_argument("--spawn-warm", metavar="NQxK,...", default="1x1",
+                   help="warm-bucket spec passed to spawned replicas")
+    p.add_argument("--spawn-batch-cap", type=int, default=32)
+    p.add_argument("--spawn-flags", default="",
+                   help="extra daemon flags for spawned replicas "
+                        "(quoted; '--mesh RxC' auto-sets XLA_FLAGS)")
+    p.add_argument("--spawn-capacity", type=int, default=None,
+                   help="explicit --capacity for spawned replicas")
+    p.add_argument("--relaunch-budget", type=int, default=3,
+                   help="total crashed-replica relaunches before "
+                        "degrading to a smaller fleet")
+    p.add_argument("--unhealthy-deadline-s", type=float, default=20.0,
+                   help="probe-dead seconds before a hung replica is "
+                        "treated as crashed")
+    p.add_argument("--reshard-threshold", type=float, default=0.9,
+                   help="corpus rows / capacity ratio that triggers "
+                        "the staged shard re-split (<=0 disables)")
+    p.add_argument("--scale-high", type=float, default=4.0)
+    p.add_argument("--scale-low", type=float, default=0.25)
+    p.add_argument("--poll-s", type=float, default=0.5,
+                   help="supervisor watch interval")
     args = p.parse_args(argv)
 
     # Idempotent backstop (the real install runs in fleet/__init__,
@@ -86,13 +144,47 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from dmlp_tpu.fleet.router import FleetRouter
 
-    replicas = _parse_replicas(args.replicas)
-    scrape_ports = _parse_ports(args.scrape_ports, len(replicas))
+    supervised = args.spawn_corpus is not None
+    if not supervised and not args.replicas:
+        raise SystemExit("need --replicas (static) or --spawn-corpus "
+                         "(supervised)")
+
+    if supervised:
+        replicas: List[Tuple[str, int]] = []
+        scrape_ports: List[Optional[int]] = []
+    else:
+        replicas = _parse_replicas(args.replicas)
+        scrape_ports = _parse_ports(args.scrape_ports, len(replicas))
     router = FleetRouter(replicas, scrape_ports=scrape_ports,
                          port=args.port,
                          health_interval_s=args.health_interval_s,
                          request_timeout_s=args.request_timeout_s,
-                         telemetry_port=args.telemetry_port)
+                         telemetry_port=args.telemetry_port,
+                         revive_probes=args.revive_probes,
+                         repair=args.repair == "on",
+                         allow_empty=supervised)
+    supervisor = None
+    if supervised:
+        from dmlp_tpu.fleet.autoscale import FleetSupervisor, ReplicaSpec
+        os.makedirs(args.out_dir, exist_ok=True)
+        spec = ReplicaSpec(args.spawn_corpus, args.out_dir,
+                           warm_spec=args.spawn_warm,
+                           batch_cap=args.spawn_batch_cap,
+                           flags=shlex.split(args.spawn_flags),
+                           capacity=args.spawn_capacity)
+        supervisor = FleetSupervisor(
+            router, spec,
+            min_replicas=args.spawn_replicas,
+            max_replicas=(args.max_replicas
+                          if args.max_replicas is not None
+                          else args.spawn_replicas + 2),
+            relaunch_budget=args.relaunch_budget,
+            poll_s=args.poll_s,
+            unhealthy_deadline_s=args.unhealthy_deadline_s,
+            scale_high=args.scale_high, scale_low=args.scale_low,
+            reshard_threshold=(args.reshard_threshold
+                               if args.reshard_threshold > 0 else None))
+        supervisor.start()
     try:
         signal.signal(signal.SIGTERM,
                       lambda s, f: router.request_drain())
@@ -100,18 +192,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         pass   # not the main thread (embedders): drain op only
     router.start()
     sys.stderr.write(f"dmlp_tpu.fleet: ready port={router.port} "
-                     f"replicas={len(replicas)}\n")
+                     f"replicas={len(router.replicas)}"
+                     f"{' (supervised)' if supervised else ''}\n")
     sys.stderr.flush()
     if args.ready_file:
         doc = {"port": router.port, "pid": os.getpid(),
-               "replicas": [r.name for r in router.replicas],
+               "replicas": [r.name for r in router.replica_list()],
                "telemetry_port": getattr(router, "telemetry_port",
                                          None)}
+        if supervisor is not None:
+            doc["managed"] = supervisor.snapshot()["managed"]
         tmp = args.ready_file + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
         os.replace(tmp, args.ready_file)
-    router.run_until_drained()
+    # Wait for the drain signal OURSELVES (not run_until_drained): the
+    # supervisor must stop BEFORE the drain propagates, or it would
+    # read the fleet-wide orderly shutdown as a mass crash and spend
+    # its relaunch budget resurrecting the replicas being drained.
+    while not router._drain_event.wait(timeout=0.2):
+        pass
+    child_rcs = []
+    if supervisor is not None:
+        supervisor.stop()
+    router.drain()
+    if supervisor is not None:
+        child_rcs = supervisor.wait_children()
     if args.record:
         from dmlp_tpu.obs.run import RunRecord, current_device
         stats = router.stats()
@@ -120,6 +226,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "requests_total": sum(stats["requests"].values()),
             "retries_total": sum(stats["retries"].values()),
             "rejected_total": sum(stats["rejected"].values()),
+            "divergences": stats["consistency"]["divergences"],
+            "repairs": stats["consistency"]["repairs"],
+            "relaunches": stats["scale"]["relaunches"],
+            "splits": stats["scale"]["splits"],
         }
         lat = stats.get("request_latency_ms")
         if lat:
@@ -128,11 +238,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             metrics["request_count"] = lat["count"]
         RunRecord(kind="fleet", tool="dmlp_tpu.fleet",
                   config={"level": "router",
-                          "replicas": len(replicas),
+                          "replicas": len(router.replicas),
+                          "supervised": supervised,
                           "mode": "closed_loop"},
                   metrics=metrics,
                   device=current_device()).append_jsonl(args.record)
     racecheck.write_report_if_requested()
+    bad = [c for c in child_rcs if c["rc"] != 0]
+    if supervisor is not None:
+        # Orderly retirements BEFORE the drain (scale-down, re-shard
+        # swap-outs) are held to the same rc-0 contract; seeded/real
+        # crashes are excluded — those are the failures the supervisor
+        # already absorbed by relaunching or degrading.
+        bad += [e for e in supervisor.snapshot()["retired"]
+                if not str(e["reason"]).startswith("crash")
+                and e["rc"] != 0]
+    if bad:
+        sys.stderr.write(f"dmlp_tpu.fleet: drained, but managed "
+                         f"replica(s) exited nonzero: {bad}\n")
+        return 1
     sys.stderr.write("dmlp_tpu.fleet: drained clean\n")
     return 0
 

@@ -44,9 +44,11 @@ inside it). Both layouts share the one global-row-id contract.
 
 from __future__ import annotations
 
+import math
 from typing import Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -101,7 +103,7 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
     def __init__(self, corpus: KNNInput, config: EngineConfig = None,
                  mesh=None, mesh_shape: Optional[Tuple[int, int]] = None,
                  capacity: Optional[int] = None,
-                 merge: str = "allgather"):
+                 merge: str = "allgather", gate_carry: bool = True):
         if merge not in ("allgather", "ring"):
             raise ValueError(f"unknown merge strategy {merge!r}")
         cfg = config or EngineConfig(mode="sharded")
@@ -128,8 +130,18 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         if cap < n:
             raise ValueError(f"capacity {cap} < corpus rows {n}")
         self.num_attrs = na
-        self.gate_carry = False        # mesh path: natural fold order
+        # Cross-request fused-gate warm-up, mesh edition (ROADMAP
+        # follow-on (e)): the single-chip hot-block histogram doesn't
+        # port 1:1 — here heat is tracked PER (shard, chunk), and the
+        # fold schedule (one dispatch covers every shard's piece of
+        # chunk t) orders chunks by their across-shard aggregate heat.
+        # The carried state is a winner histogram, never a threshold:
+        # within a request thresholds only tighten, so the fold is
+        # sound in any order and carry on/off stay byte-identical
+        # (boundary repair makes candidate-edge ties exact).
+        self.gate_carry = bool(gate_carry)
         self.last_gated_fraction = None
+        self._pending_gate: Optional[Tuple] = None
 
         # -- per-shard chunk plan at CAPACITY shape (fixed for life) ---------
         self._extract_ok = (cfg.use_pallas and cfg.resolve_select(
@@ -153,6 +165,7 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         self._host_labels = np.full(self.capacity_rows, -1, np.int32)
         self._host_labels[:n] = corpus.labels
         self.n_real = n
+        self._sig_init()
         # Corpus max squared norm for the boundary-repair eps — cached
         # (an O(n*a) host pass per micro-batch would sit in every
         # request's tail latency at corpus scale), updated
@@ -181,6 +194,10 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         self.last_prune_fraction = None
         if self._chunks is not None:
             self._build_summaries()
+        # Gate-carry state: per-(shard, chunk) winner histogram.
+        self._block_hits = np.zeros((r, max(self._nchunks, 1)), np.int64)
+        telemetry.registry().gauge("serve.gate.carry_enabled").set(
+            int(self.gate_carry))
 
         # -- bucket registry + compile bookkeeping ---------------------------
         self._buckets: Dict[Tuple[int, int], _MeshBucket] = {}
@@ -388,9 +405,12 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         # can claim the count that will ACTUALLY dispatch — claiming
         # nchunks would overstate the modeled fold work exactly when
         # pruning (or a part-empty capacity tail) is doing its job.
+        # The walk follows _chunk_order(): hottest chunks first when
+        # gate carry-over is on, so every query's k-th-best thresholds
+        # tighten before the cold chunks' tiles reach the MXU gate.
         schedule = []
         scanned = 0
-        for t in range(self._nchunks):
+        for t in self._chunk_order():
             live_col = None if keep_m is None else keep_m[:, t]
             spans = [self._block_span(rr, t) for rr in range(r)]
             real = [hi > lo for lo, hi in spans]
@@ -408,9 +428,12 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
                            (entry.qloc, cr, self.num_attrs, k),
                            kernel=impl)
         self._last_select = "extract"
+        gz = None
+        ntiles = 0
         with obs_span("fleet.solve_resident", qpad=entry.qpad, kcap=k,
                       chunks=self._nchunks, scheduled=len(schedule),
-                      impl=impl, mesh=[r, c]):
+                      impl=impl, mesh=[r, c],
+                      carry=self.gate_carry):
             for t, live_col in schedule:
                 lv = self._ones_live if live_col is None \
                     else jax.device_put(np.asarray(live_col, np.int32),
@@ -423,10 +446,18 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
                 cd, ci, its = step(cd, ci, self._chunks[t], q_dev,
                                    self._sc_dev[t], lv)
                 mi.add(its)
+                # Gate effectiveness: a 0-iteration tile was gated (or
+                # skip-gated) outright — summed on device, read back
+                # once per micro-batch in _after_batch. The tile COUNT
+                # is static shape metadata, no transfer.
+                z = jnp.sum(its == 0)
+                gz = z if gz is None else gz + z
+                ntiles += math.prod(its.shape)
                 dispatched += 1
                 throttle.tick(cd)
                 telemetry.sample_memory_now()
         mi.done()
+        self._pending_gate = (gz, ntiles) if gz is not None else None
         blocks_total = sum(1 for rr in range(r)
                            for t in range(self._nchunks)
                            if self._block_span(rr, t)[1]
@@ -446,6 +477,48 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
             top = merge_fn(cd, ci, self._lab_dev)
             sp.fence(top.dists)
         return top
+
+    def _chunk_order(self) -> List[int]:
+        """Fold order over the resident chunks: every dispatch of chunk
+        ``t`` covers ALL shards' ``t``-th pieces, so the schedule is one
+        permutation of ``t`` — ordered by the chunks' across-shard
+        aggregate winner count (hottest first) when gate carry-over is
+        on, natural otherwise. Stable sort: cold chunks keep their
+        natural relative order."""
+        if not self.gate_carry:
+            return list(range(self._nchunks))
+        heat = self._block_hits.sum(axis=0)
+        return list(np.argsort(-heat[:self._nchunks], kind="stable"))
+
+    def _after_batch(self, results: List[QueryResult]) -> None:
+        """Cross-request gate bookkeeping (the single-chip resident
+        engine's discipline, per-shard): flush the pending gated-tile
+        readback, then credit each winner row's owning (shard, chunk)
+        block in the carried histogram."""
+        if self._pending_gate is not None:
+            gz, ntiles = self._pending_gate
+            self._pending_gate = None
+            try:
+                gated = int(jax.device_get(gz))  # check: allow-host-sync
+                frac = gated / max(ntiles, 1)
+                self.last_gated_fraction = frac
+                reg = telemetry.registry()
+                reg.gauge("serve.gate.gated_fraction").set(round(frac, 6))
+                reg.counter("serve.gate.tiles_total").inc(ntiles)
+                reg.counter("serve.gate.tiles_gated").inc(gated)
+            except Exception:  # check: no-retry — stats never fail a batch
+                pass
+        if self.gate_carry and self._nchunks and results:
+            ids = np.concatenate(
+                [np.asarray(r.neighbor_ids, np.int64) for r in results])
+            ids = ids[ids >= 0]
+            if ids.size:
+                r, _ = self.mesh.devices.shape
+                rr = ids // self._shard_rows
+                t = (ids - rr * self._shard_rows) // self._chunk_rows
+                hits = np.bincount(rr * self._nchunks + t,
+                                   minlength=r * self._nchunks)
+                self._block_hits += hits.reshape(r, self._nchunks)
 
     def _solve_resident_stream(self, inp: KNNInput, entry: _MeshBucket):
         """Streaming fallback on the resident MONOLITHIC arrays: the
@@ -483,6 +556,7 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         self.last_extract_impl = None
         self.last_prune = None
         self.last_prune_fraction = None
+        self._pending_gate = None
         memwatch.note_engine_model(self, inp)
         entry = self._bucket_entry(nq, kmax)
         if entry.path == "extract":
@@ -519,16 +593,19 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
                     repair_boundary_overflow(results, suspects, inp)
                     self.last_repairs += int(suspects.size)
         flush_measured_iters(self)
+        self._after_batch(results)
         return results
 
     # -- incremental shard-routed ingestion -----------------------------------
 
-    def ingest(self, labels, attrs) -> int:
-        """Append rows behind the row-count mask. Rows land at their
+    def ingest(self, labels, attrs, start: Optional[int] = None) -> int:
+        """Write rows behind the row-count mask. Rows land at their
         global positions — the owning shard's span of the touched chunk
         buffers — by restaging exactly those fixed-shape device arrays
-        (and the touched blocks' summaries). No solve program sees a
-        new shape: zero recompilation, counter-asserted."""
+        (and the touched blocks' summaries). ``start=None`` appends;
+        ``start <= n_real`` is the idempotent row-write keyed by global
+        row id the fleet's consistency repair replays. No solve program
+        sees a new shape: zero recompilation, counter-asserted."""
         labels = np.asarray(labels, np.int32).reshape(-1)
         attrs = np.asarray(attrs, np.float64)
         if attrs.ndim != 2 or attrs.shape[1] != self.num_attrs:
@@ -540,26 +617,33 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
             raise ValueError("labels/attrs row-count mismatch")
         if m == 0:
             return self.n_real
-        start = self.n_real
-        new_n = start + m
-        if new_n > self.capacity_rows:
+        at = self.n_real if start is None else int(start)
+        if at < 0 or at > self.n_real:
+            raise ValueError(
+                f"ingest start {at} beyond resident rows "
+                f"{self.n_real} (row-writes may overwrite or append, "
+                "never leave gaps)")
+        end = at + m
+        new_n = max(self.n_real, end)
+        if end > self.capacity_rows:
             raise CapacityError(
-                f"ingest of {m} rows exceeds capacity "
-                f"{self.capacity_rows} (resident: {start})")
+                f"ingest of {m} rows at {at} exceeds capacity "
+                f"{self.capacity_rows} (resident: {self.n_real})")
         r, _ = self.mesh.devices.shape
         sr, cr = self._shard_rows, self._chunk_rows
         with obs_span("fleet.ingest", rows=m, corpus_rows=new_n):
-            self._host_attrs[start:new_n] = attrs
-            self._host_labels[start:new_n] = labels
+            self._host_attrs[at:end] = attrs
+            self._host_labels[at:end] = labels
             self.n_real = new_n
             self._note_ingested_norms(attrs)
-            # Touched (shard, chunk) blocks from the [start, new_n)
+            self._sig_update(at, end)
+            # Touched (shard, chunk) blocks from the [at, end)
             # span by block arithmetic — never a per-row Python loop
             # (a corpus-scale append would stall the solve loop).
             touched = []
             for rr in range(r):
-                lo = max(start, rr * sr)
-                hi = min(new_n, (rr + 1) * sr)
+                lo = max(at, rr * sr)
+                hi = min(end, (rr + 1) * sr)
                 if hi <= lo:
                     continue
                 t_lo = (lo - rr * sr) // cr

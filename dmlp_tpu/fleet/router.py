@@ -28,11 +28,25 @@ Routing policy (the part the tests pin down):
   router itself (rc 0 — the smoke's drain contract).
 
 Health: a background prober calls each replica's ``stats`` op on an
-interval, reviving marked-dead replicas that answer again and marking
-draining ones; request-path failures mark immediately. Replica
-connections are PER-REQUEST (no shared sockets), so no thread ever
-blocks on I/O while holding a lock — check rule R703 stays clean by
-construction.
+interval; request-path failures mark a replica down immediately, and a
+marked-down replica rejoins only after ``revive_probes`` CONSECUTIVE
+healthy probes (revive hysteresis — a flapping replica used to rejoin
+on its first good probe and eat a retry budget per flap). The prober
+also compares the replicas' corpus signatures (rows + rolling
+checksum, exposed in ``stats``) and, on divergence observed across
+consecutive probe rounds, drives the checksum-driven consistency
+repair (``fleet/consistency.py``): targeted re-ingest of the delta
+into the lagging replica, with unrepairable divergence escalating to
+QUARANTINE (marked down, never revived —
+``fleet.consistency.{divergences,repairs,unrepairable}`` counters).
+
+The replica table is DYNAMIC: :meth:`FleetRouter.add_replica` /
+:meth:`FleetRouter.remove_replica` let the auto-scaling supervisor
+(``fleet/autoscale.py``) and the re-shard choreography
+(``fleet/reshard.py``) grow, shrink, and atomically swap entries while
+traffic flows. Replica connections are PER-REQUEST (no shared
+sockets), so no thread ever blocks on I/O while holding a lock — check
+rule R703 stays clean by construction.
 """
 
 from __future__ import annotations
@@ -59,18 +73,29 @@ class Replica:
     guards pure state only)."""
 
     def __init__(self, host: str, port: int,
-                 scrape_port: Optional[int] = None, index: int = 0):
+                 scrape_port: Optional[int] = None, index: int = 0,
+                 revive_probes: int = 1):
         self.host, self.port = host, int(port)
         self.scrape_port = scrape_port
         self.index = index
         self.name = f"{host}:{port}"
+        self.revive_probes = max(int(revive_probes), 1)
         self._lock = threading.Lock()
         self._healthy = True
         self._draining = False
+        self._force_drain = False      # router-side freeze: sticky
+        #                                against probe updates
+        self._quarantined = False
+        self._streak = 0               # consecutive healthy probes
+        self._down_since: Optional[float] = None
         self._inflight = 0
         self._requests = 0
         self._failures = 0
         self._last_error: Optional[str] = None
+        #: last probed corpus signature ({rows, checksum, epoch}) and
+        #: engine capacity — the consistency/re-shard inputs
+        self.last_corpus: Optional[Dict[str, int]] = None
+        self.capacity_rows: Optional[int] = None
 
     # -- guarded state ---------------------------------------------------------
 
@@ -78,10 +103,14 @@ class Replica:
         with self._lock:
             return {"replica": self.name, "healthy": self._healthy,
                     "draining": self._draining,
+                    "quarantined": self._quarantined,
                     "inflight": self._inflight,
                     "requests": self._requests,
                     "failures": self._failures,
-                    "last_error": self._last_error}
+                    "last_error": self._last_error,
+                    "corpus": dict(self.last_corpus)
+                    if self.last_corpus else None,
+                    "capacity_rows": self.capacity_rows}
 
     def mark(self, healthy: Optional[bool] = None,
              draining: Optional[bool] = None,
@@ -89,15 +118,77 @@ class Replica:
         with self._lock:
             if healthy is not None:
                 self._healthy = healthy
+                self._streak = 0       # request-path verdicts reset
+                #                        the revive hysteresis either way
+                self._down_since = None if healthy else (
+                    self._down_since or time.monotonic())
             if draining is not None:
+                # An explicit mark is the ROUTER's decision (re-shard
+                # freeze, drain propagation) and must survive probe
+                # refreshes — the probed replica's own admission state
+                # says nothing about a router-side freeze.
                 self._draining = draining
+                self._force_drain = draining
             if error is not None:
                 self._last_error = error
                 self._failures += 1
 
+    def probe_ok(self, draining: bool = False,
+                 corpus: Optional[Dict[str, int]] = None,
+                 capacity_rows: Optional[int] = None) -> None:
+        """One healthy probe. A marked-down replica needs
+        ``revive_probes`` CONSECUTIVE healthy probes before it routes
+        again — a flapping replica must not rejoin on its first good
+        answer and eat a retry budget per flap. Quarantine never
+        revives (the consistency escalation is terminal), and a
+        router-side drain freeze (``mark(draining=True)``) is sticky —
+        the probed daemon's admission state cannot un-freeze it."""
+        with self._lock:
+            self._draining = draining or self._force_drain
+            if corpus is not None:
+                self.last_corpus = corpus
+            if capacity_rows is not None:
+                self.capacity_rows = int(capacity_rows)
+            if self._quarantined:
+                return
+            if not self._healthy:
+                self._streak += 1
+                if self._streak >= self.revive_probes:
+                    self._healthy = True
+                    self._streak = 0
+                    self._down_since = None
+            else:
+                self._down_since = None
+
+    def probe_fail(self, error: str) -> None:
+        with self._lock:
+            self._healthy = False
+            self._streak = 0
+            self._down_since = self._down_since or time.monotonic()
+            self._last_error = error
+            self._failures += 1
+
+    def quarantine(self, reason: str) -> None:
+        """Terminal mark-down: unrepairable divergence. The prober
+        keeps probing but never revives a quarantined replica."""
+        with self._lock:
+            self._quarantined = True
+            self._healthy = False
+            self._streak = 0
+            self._last_error = f"quarantined: {reason}"
+
+    def down_for(self) -> float:
+        """Seconds this replica has been continuously marked down
+        (0 while healthy) — the supervisor's hung-replica deadline."""
+        with self._lock:
+            if self._down_since is None:
+                return 0.0
+            return max(time.monotonic() - self._down_since, 0.0)
+
     def available(self) -> bool:
         with self._lock:
-            return self._healthy and not self._draining
+            return (self._healthy and not self._draining
+                    and not self._quarantined)
 
     def load(self) -> int:
         with self._lock:
@@ -181,26 +272,46 @@ class FleetRouter:
                  scrape_ports: Optional[List[Optional[int]]] = None,
                  port: int = 0, health_interval_s: float = 1.0,
                  request_timeout_s: float = 600.0,
-                 telemetry_port: Optional[int] = None):
+                 telemetry_port: Optional[int] = None,
+                 revive_probes: int = 1, repair: bool = True,
+                 divergence_probes: int = 2,
+                 allow_empty: bool = False):
         scrape_ports = scrape_ports or [None] * len(replicas)
         if len(scrape_ports) != len(replicas):
             raise ValueError("one scrape port per replica (or none)")
-        if not replicas:
-            raise ValueError("a fleet needs at least one replica")
+        if not replicas and not allow_empty:
+            raise ValueError("a fleet needs at least one replica "
+                             "(allow_empty is the supervised-spawn "
+                             "bootstrap only)")
         # The registry is process-global but stats() divides by THIS
         # router's lifetime: zero the fleet.* counters so a second
         # router in one process (tests, embedders) doesn't inherit the
         # first one's retries/rejections — same discipline as the
         # daemon's serve.* reset.
         telemetry.registry().reset(prefix="fleet")
-        self.replicas = [Replica(h, p, scrape_port=sp, index=i)
+        self.revive_probes = max(int(revive_probes), 1)
+        self.repair = bool(repair)
+        self.divergence_probes = max(int(divergence_probes), 1)
+        # The replica TABLE is mutable (autoscale/reshard add, remove,
+        # and swap entries live); mutations run under _lock, iteration
+        # sites take a list() snapshot (atomic under the GIL) and never
+        # hold the lock across I/O.
+        self.replicas = [Replica(h, p, scrape_port=sp, index=i,
+                                 revive_probes=self.revive_probes)
                          for i, ((h, p), sp)
                          in enumerate(zip(replicas, scrape_ports))]
+        self._next_index = len(self.replicas)
+        #: optional fleet supervisor (autoscale.FleetSupervisor sets
+        #: itself here so stats() can expose its snapshot)
+        self.supervisor = None
         self.request_timeout_s = request_timeout_s
         self.health_interval_s = health_interval_s
-        self._lock = threading.Lock()     # guards _rr + _draining only
+        self._lock = threading.Lock()     # guards _rr + _draining +
+        #                                   replica-table mutations only
         self._rr = 0
         self._draining = False
+        self._div_streak = 0              # health-thread-local state
+        self._scrape_cache = None         # lazy fleet.scrape.ScrapeCache
         self._drain_event = threading.Event()
         self._inflight = 0
         self._inflight_cond = threading.Condition()
@@ -213,6 +324,65 @@ class FleetRouter:
         self._telemetry_port = telemetry_port
         self._telemetry_httpd = None
         self._t_ready: Optional[float] = None
+
+    # -- the dynamic replica table ---------------------------------------------
+
+    def replica_list(self) -> List[Replica]:
+        """Snapshot of the live table (iteration never holds _lock)."""
+        return list(self.replicas)
+
+    def find_replica(self, name: str) -> Optional[Replica]:
+        return next((r for r in self.replica_list() if r.name == name),
+                    None)
+
+    def add_replica(self, host: str, port: int,
+                    scrape_port: Optional[int] = None) -> Replica:
+        """Register a new backend (autoscale scale-up / re-shard swap-
+        in). Probed once BEFORE it enters the table so the first client
+        request never lands on a replica we have not seen answer."""
+        rep = Replica(host, port, scrape_port=scrape_port,
+                      revive_probes=self.revive_probes)
+        self._probe(rep)
+        with self._lock:
+            rep.index = self._next_index
+            self._next_index += 1
+            self.replicas.append(rep)
+        telemetry.registry().counter("fleet.scale.table").inc(
+            label="add")
+        return rep
+
+    def remove_replica(self, name: str,
+                       drain: bool = False) -> Optional[Replica]:
+        """Drop a backend from the table (scale-down retire / crash /
+        re-shard swap-out); ``drain=True`` also sends the in-band drain
+        op (the daemon finishes queued work and exits 0 — the caller
+        owns waiting on the process). In-flight relays complete on
+        their own per-request connections either way."""
+        with self._lock:
+            rep = next((r for r in self.replicas if r.name == name),
+                       None)
+            if rep is not None:
+                self.replicas.remove(rep)
+        if rep is None:
+            return None
+        rep.mark(draining=True)
+        if drain:
+            try:
+                rep.call(b'{"op": "drain"}\n', timeout_s=30.0,
+                         probe=True)
+            except OSError:
+                pass   # already gone: that IS drained
+        if self._scrape_cache is not None:
+            self._scrape_cache.forget(rep.name)
+        # The freshness gauges describe live table entries: a retired
+        # replica's labels must leave the merged exposition too, or a
+        # long-lived supervised fleet accumulates one dead label pair
+        # per retirement.
+        reg = telemetry.registry()
+        reg.gauge("fleet.replica_scrape_age_s").remove(rep.name)
+        reg.gauge("fleet.replica_scrape_stale").remove(rep.name)
+        reg.counter("fleet.scale.table").inc(label="remove")
+        return rep
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -247,14 +417,17 @@ class FleetRouter:
             self._draining = True
         telemetry.registry().gauge("fleet.ready").set(0)
         if propagate:
-            for rep in self.replicas:
+            for rep in self.replica_list():
                 try:
                     rep.call(b'{"op": "drain"}\n', timeout_s=30.0,
                              probe=True)
                 except OSError:
                     pass   # already gone: that IS drained
                 rep.mark(draining=True)
-        self._server.shutdown()
+        # shutdown() blocks on serve_forever's ack — which never comes
+        # if start() was never called (embedders, tests): skip it then.
+        if self._server_thread is not None:
+            self._server.shutdown()
         self._wait_inflight_drained()
         self._stop_health.set()
         if self._telemetry_httpd is not None:
@@ -267,7 +440,8 @@ class FleetRouter:
             self._draining = True
         self._drain_event.set()
         self._stop_health.set()
-        self._server.shutdown()
+        if self._server_thread is not None:
+            self._server.shutdown()
         if self._telemetry_httpd is not None:
             self._telemetry_httpd.shutdown()
         self._server.server_close()
@@ -294,17 +468,80 @@ class FleetRouter:
             raw = rep.call(b'{"op": "stats"}\n', timeout_s=10.0,
                            probe=True)
             doc = json.loads(raw)
-            draining = bool(
-                doc.get("stats", {}).get("admission", {}).get("draining"))
-            rep.mark(healthy=True, draining=draining)
+            st = doc.get("stats", {}) if isinstance(doc, dict) else {}
+            draining = bool(st.get("admission", {}).get("draining"))
+            corpus = st.get("corpus")
+            cap = st.get("engine", {}).get("capacity_rows")
+            rep.probe_ok(draining=draining,
+                         corpus=corpus if isinstance(corpus, dict)
+                         else None,
+                         capacity_rows=cap if isinstance(cap, int)
+                         else None)
         except (OSError, ValueError) as e:
-            rep.mark(healthy=False, error=f"probe: {e}")
+            rep.probe_fail(f"probe: {e}")
 
     def _probe_all(self) -> None:
-        for rep in self.replicas:
+        reps = self.replica_list()
+        for rep in reps:
             self._probe(rep)
         telemetry.registry().gauge("fleet.replicas_healthy").set(
-            sum(1 for r in self.replicas if r.available()))
+            sum(1 for r in reps if r.available()))
+        if self.repair:
+            self._consistency_tick()
+
+    def _consistency_tick(self) -> None:
+        """Compare the probed corpus signatures; on divergence seen on
+        ``divergence_probes`` CONSECUTIVE rounds (one round can catch a
+        fan-out mid-flight — replicas legitimately disagree for the
+        milliseconds between sequential ingests), drive the targeted
+        delta re-ingest; unrepairable divergence quarantines. Runs on
+        the health thread, no locks held across the repair I/O."""
+        from dmlp_tpu.fleet import consistency as ccs
+        reg = telemetry.registry()
+        sigs = [(r, r.last_corpus) for r in self.replica_list()
+                if r.available() and r.last_corpus]
+        if len(sigs) < 2:
+            self._div_streak = 0
+            return
+        verdict = ccs.diagnose([(r.name, sig) for r, sig in sigs])
+        if verdict is None:
+            self._div_streak = 0
+            return
+        self._div_streak += 1
+        if self._div_streak < self.divergence_probes:
+            return
+        self._div_streak = 0
+        reg.counter("fleet.consistency.divergences").inc()
+        by_name = {r.name: r for r, _sig in sigs}
+        ref = by_name.get(verdict["reference"])
+        if ref is None:
+            return
+        from dmlp_tpu.obs.trace import instant as obs_instant
+        obs_instant("fleet.consistency.divergence",
+                    reference=verdict["reference"],
+                    rows=verdict["rows"],
+                    divergent=",".join(verdict["divergent"]))
+        for name in verdict["divergent"]:
+            tgt = by_name.get(name)
+            if tgt is None:
+                continue
+            res = ccs.repair_replica(ref, tgt)
+            if res["repaired"]:
+                reg.counter("fleet.consistency.repairs").inc()
+                reg.counter("fleet.consistency.repaired_rows").inc(
+                    res["replayed_rows"])
+                obs_instant("fleet.consistency.repair", replica=name,
+                            rows=res["replayed_rows"],
+                            rounds=res["rounds"])
+            else:
+                # Escalation: a replica the repair cannot converge is
+                # marked down FOR GOOD — serving two truths is the one
+                # failure byte-identity cannot absorb.
+                reg.counter("fleet.consistency.unrepairable").inc()
+                tgt.quarantine(res.get("reason", "divergence"))
+                telemetry.flight_event(
+                    "fleet.consistency.unrepairable", replica=name,
+                    reason=res.get("reason", ""))
 
     def _health_loop(self, stop: threading.Event) -> None:
         while not stop.wait(timeout=self.health_interval_s):
@@ -314,7 +551,7 @@ class FleetRouter:
 
     def _pick(self, exclude) -> Optional[Replica]:
         """Least-inflight available replica, round-robin on ties."""
-        avail = [r for r in self.replicas
+        avail = [r for r in self.replica_list()
                  if r not in exclude and r.available()]
         if not avail:
             return None
@@ -364,7 +601,7 @@ class FleetRouter:
         reg = telemetry.registry()
         tried: set = set()
         last_error = "no healthy replica"
-        for _attempt in range(len(self.replicas)):
+        for _attempt in range(max(len(self.replicas), 1)):
             rep = self._pick(tried)
             if rep is None:
                 break
@@ -410,7 +647,7 @@ class FleetRouter:
         partial ingest forks the fleet corpus — the response names the
         divergent replicas instead of hiding them)."""
         reg = telemetry.registry()
-        targets = [r for r in self.replicas if r.available()]
+        targets = [r for r in self.replica_list() if r.available()]
         if not targets:
             reg.counter("fleet.rejected").inc(label="unavailable")
             return encode({"ok": False,
@@ -440,19 +677,45 @@ class FleetRouter:
 
     def stats(self) -> Dict[str, Any]:
         reg = telemetry.registry()
+        reps = self.replica_list()
         elapsed = (time.monotonic() - self._t_ready) \
             if self._t_ready else 0.0
         out: Dict[str, Any] = {
             "fleet": True,
-            "replicas": [r.snapshot() for r in self.replicas],
-            "healthy_replicas": sum(1 for r in self.replicas
+            "replicas": [r.snapshot() for r in reps],
+            "healthy_replicas": sum(1 for r in reps
                                     if r.available()),
             "draining": self._draining_now(),
             "uptime_s": round(elapsed, 3),
             "requests": reg.counter("fleet.requests").by_label(),
             "retries": reg.counter("fleet.retries").by_label(),
             "rejected": reg.counter("fleet.rejected").by_label(),
+            "consistency": {
+                "divergences": int(reg.counter(
+                    "fleet.consistency.divergences").total()),
+                "repairs": int(reg.counter(
+                    "fleet.consistency.repairs").total()),
+                "repaired_rows": int(reg.counter(
+                    "fleet.consistency.repaired_rows").total()),
+                "unrepairable": int(reg.counter(
+                    "fleet.consistency.unrepairable").total()),
+            },
+            "scale": {
+                "up": int(reg.counter("fleet.scale.up").total()),
+                "down": int(reg.counter("fleet.scale.down").total()),
+                "crashes": int(reg.counter(
+                    "fleet.scale.crashes").total()),
+                "relaunches": int(reg.counter(
+                    "fleet.scale.relaunches").total()),
+                "splits": int(reg.counter(
+                    "fleet.reshard.splits").total()),
+            },
         }
+        if self.supervisor is not None:
+            try:
+                out["supervisor"] = self.supervisor.snapshot()
+            except Exception:  # check: no-retry — stats never fail
+                pass
         h = reg.get("fleet.request_latency_ms")
         if h is not None and h.count:
             out["request_latency_ms"] = {
@@ -466,19 +729,38 @@ class FleetRouter:
     def fleet_metrics_text(self) -> str:
         """The aggregated fleet OpenMetrics view: every replica's live
         scrape (those with a scrape port) merged by fleet.scrape, plus
-        the router's own registry as one more 'replica'."""
+        the router's own registry as one more 'replica'. A replica
+        whose live scrape fails keeps its LAST-GOOD exposition in the
+        merge — stamped, never silent: the router publishes per-replica
+        ``fleet_replica_scrape_age_s`` (0 when live) and
+        ``fleet_replica_scrape_stale`` gauges alongside the merged
+        counters, so a dashboard can tell fresh fleet totals from ones
+        coasting on a cached scrape."""
         from dmlp_tpu.fleet import scrape as fscrape
-        texts = [telemetry.registry().to_openmetrics()]
-        names = ["router"]
-        for rep in self.replicas:
+        if self._scrape_cache is None:
+            self._scrape_cache = fscrape.ScrapeCache()
+        reg = telemetry.registry()
+        texts: List[str] = []
+        names: List[str] = []
+        for rep in self.replica_list():
             if rep.scrape_port is None:
                 continue
-            try:
-                texts.append(fscrape.scrape_url(
-                    f"http://{rep.host}:{rep.scrape_port}/metrics"))
-                names.append(rep.name)
-            except OSError:
-                continue   # down replica: degrade, don't vanish
+            text, age_s, stale = self._scrape_cache.fetch(
+                rep.name,
+                f"http://{rep.host}:{rep.scrape_port}/metrics")
+            if text is None:
+                continue   # never scraped: nothing to go stale
+            reg.gauge("fleet.replica_scrape_age_s").set(
+                round(age_s, 3), label=rep.name)
+            reg.gauge("fleet.replica_scrape_stale").set(
+                int(stale), label=rep.name)
+            texts.append(text)
+            names.append(rep.name)
+        # The router's own registry is SNAPSHOTTED after the loop so
+        # the freshness gauges just written land in this very
+        # exposition (merge order is cosmetic).
+        texts.insert(0, reg.to_openmetrics())
+        names.insert(0, "router")
         merged, _problems = fscrape.merge_expositions(texts, names)
         return merged
 

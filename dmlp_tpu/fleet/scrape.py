@@ -18,15 +18,20 @@ metric semantics, not by string concatenation:
 The merged exposition passes ``obs.telemetry.validate_openmetrics``
 (asserted in tests and the fleet smoke); ``tools/fleet_scrape.py`` is
 the CLI, and the router's ``--telemetry-port`` serves the same merge
-live.
+live — through a :class:`ScrapeCache`, so a replica whose live scrape
+fails keeps its last-good counters in the fleet totals but the reuse
+is stamped per replica (``fleet_replica_scrape_age_s`` /
+``fleet_replica_scrape_stale``), never silently merged as fresh.
 """
 
 from __future__ import annotations
 
 import math
 import re
+import threading
+import time
 import urllib.request
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 _TYPE_RE = re.compile(
     r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|histogram)$")
@@ -194,6 +199,49 @@ def merge_expositions(texts: List[str],
 def scrape_url(url: str, timeout_s: float = 10.0) -> str:
     with urllib.request.urlopen(url, timeout=timeout_s) as r:
         return r.read().decode()
+
+
+class ScrapeCache:
+    """Last-good scrape per replica + its monotonic stamp — the
+    staleness fix: when a replica's live scrape fails, the merged
+    fleet view REUSES the cached exposition (so fleet counter totals
+    don't collapse the moment one replica blips) but the reuse is
+    STAMPED, never silent — the router publishes
+    ``fleet_replica_scrape_age_s`` (seconds since the last good
+    scrape, 0 when live) and ``fleet_replica_scrape_stale`` (1 when
+    the merge is running on a cached exposition) gauges per replica.
+    A replica never scraped contributes nothing (no data to go stale).
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 fetch: Callable[[str], str] = scrape_url):
+        self._clock = clock
+        self._fetch = fetch
+        self._lock = threading.Lock()      # leaf: guards the dict only
+        self._cache: Dict[str, Tuple[str, float]] = {}
+
+    def fetch(self, name: str, url: str
+              ) -> Tuple[Optional[str], float, bool]:
+        """-> (exposition text | None, age_s, stale?). The network
+        call runs outside the lock (check rule R703)."""
+        try:
+            text = self._fetch(url)
+        except OSError:
+            with self._lock:
+                cached = self._cache.get(name)
+            if cached is None:
+                return None, 0.0, True
+            text, stamp = cached
+            return text, max(self._clock() - stamp, 0.0), True
+        with self._lock:
+            self._cache[name] = (text, self._clock())
+        return text, 0.0, False
+
+    def forget(self, name: str) -> None:
+        """Drop a retired replica's cache (its counters must not haunt
+        the merge after the routing table drops it)."""
+        with self._lock:
+            self._cache.pop(name, None)
 
 
 def fleet_view(sources: List[str],

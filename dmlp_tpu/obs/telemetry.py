@@ -138,6 +138,14 @@ class Gauge:
         with self._lock:
             self._values[label] = float(v)
 
+    def remove(self, label: str) -> None:
+        """Drop one label's sample. Gauges describe CURRENT state, so
+        an entity that ceases to exist (a retired fleet replica) must
+        leave the exposition — a counter's history, by contrast, is
+        never removed."""
+        with self._lock:
+            self._values.pop(label, None)
+
     def value(self, label: str = "") -> Optional[float]:
         with self._lock:
             return self._values.get(label)

@@ -81,6 +81,12 @@ SITES: Dict[str, str] = {
                    "fault here is the injected memory squeeze — the "
                    "controller must SHED the request before any "
                    "allocation, visibly, with no ladder degradation)",
+    "serve.ingest": "serving-daemon ingest execution "
+                    "(serve.batching.MicroBatcher._execute_ingest; a "
+                    "transient fault here is the injected DROPPED "
+                    "ingest — this replica's corpus silently lags the "
+                    "fleet until the router's checksum-driven "
+                    "consistency repair re-delivers the rows)",
 }
 
 KINDS = ("delay", "transient", "oom", "corrupt", "nan")

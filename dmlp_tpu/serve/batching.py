@@ -26,6 +26,7 @@ import numpy as np
 
 from dmlp_tpu.obs import telemetry
 from dmlp_tpu.obs.trace import span as obs_span
+from dmlp_tpu.resilience import inject as rs_inject
 from dmlp_tpu.serve.admission import ACCEPT, AdmissionController
 from dmlp_tpu.serve.engine import ResidentEngine
 
@@ -35,9 +36,11 @@ TICK_S = 0.002
 
 @dataclasses.dataclass
 class Request:
-    """One admitted unit of work. ``kind`` is "query" | "ingest";
-    ingest requests execute standalone between micro-batches (the one
-    batcher thread serializes them against solves)."""
+    """One admitted unit of work. ``kind`` is "query" | "ingest" |
+    "corpus"; non-query requests execute standalone between
+    micro-batches (the one batcher thread serializes them against
+    solves — a ``corpus`` read can therefore never observe a torn
+    ingest)."""
 
     kind: str
     req_id: str = ""
@@ -45,6 +48,9 @@ class Request:
     ks: Optional[np.ndarray] = None               # (nq,) int32
     labels: Optional[np.ndarray] = None           # ingest: (m,) int32
     attrs: Optional[np.ndarray] = None            # ingest: (m, na) f64
+    start: Optional[int] = None                   # ingest row-write /
+    #                                               corpus read offset
+    count: Optional[int] = None                   # corpus read length
     debug: bool = False                           # echo neighbors/dists
     t_enqueue: float = dataclasses.field(default_factory=time.monotonic)
     done: threading.Event = dataclasses.field(
@@ -53,6 +59,7 @@ class Request:
     error: Optional[str] = None
     latency_ms: Optional[float] = None
     corpus_rows: Optional[int] = None             # ingest outcome
+    payload: Optional[Dict[str, Any]] = None      # corpus outcome
 
     @property
     def nq(self) -> int:
@@ -114,9 +121,9 @@ class MicroBatcher:
             if decision["verdict"] != ACCEPT:
                 req.complete(error=f"rejected: {decision['reason']}")
             return decision
-        # Ingest rides the same queue (serialized against solves) but
-        # skips the per-query admission gates; capacity errors surface
-        # at execution.
+        # Ingest + corpus reads ride the same queue (serialized against
+        # solves) but skip the per-query admission gates; capacity
+        # errors surface at execution.
         with self._cond:
             if self.admission.draining:
                 req.complete(error="rejected: draining")
@@ -184,11 +191,11 @@ class MicroBatcher:
             total = 0
             while self._queue:
                 head = self._queue[0]
-                if head.kind == "ingest":
+                if head.kind != "query":
                     if batch:
                         break          # solve what we have first
                     self._queue.popleft()
-                    return [head]      # ingest executes standalone
+                    return [head]      # ingest/corpus execute standalone
                 if batch and total + head.nq > self.max_batch_queries:
                     break
                 self._queue.popleft()
@@ -212,13 +219,42 @@ class MicroBatcher:
                 continue
             if batch[0].kind == "ingest":
                 self._execute_ingest(batch[0])
+            elif batch[0].kind == "corpus":
+                self._execute_corpus(batch[0])
             else:
                 self._execute_batch(batch)
 
     def _execute_ingest(self, req: Request) -> None:
         try:
-            rows = self.engine.ingest(req.labels, req.attrs)
+            # The fleet chaos harness's dropped-ingest site: a
+            # transient fault here fails THIS replica's ingest before
+            # any state is touched — the router reports the divergence
+            # and the consistency repairer must re-deliver the rows.
+            rs_inject.fire("serve.ingest", rows=int(len(req.labels)),
+                           start=-1 if req.start is None
+                           else int(req.start))
+            rows = self.engine.ingest(req.labels, req.attrs,
+                                      start=req.start)
             req.complete(corpus_rows=rows)
+        except Exception as e:  # check: no-retry — surfaced to the client
+            req.complete(error=f"{type(e).__name__}: {e}")
+
+    def _execute_corpus(self, req: Request) -> None:
+        """Serve one ``corpus`` read on the batcher thread: the rows
+        and the signature are one snapshot (no ingest can interleave)."""
+        try:
+            state = self.engine.corpus_state()
+            labels, attrs = self.engine.corpus_slice(req.start or 0,
+                                                     req.count or 0)
+            req.payload = {
+                "start": max(0, min(int(req.start or 0), state["rows"])),
+                "labels": [int(v) for v in labels],
+                "rows": [[float(x) for x in row] for row in attrs],
+                "corpus_rows": state["rows"],
+                "checksum": state["checksum"],
+                "epoch": state["epoch"],
+            }
+            req.complete()
         except Exception as e:  # check: no-retry — surfaced to the client
             req.complete(error=f"{type(e).__name__}: {e}")
 

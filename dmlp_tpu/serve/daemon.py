@@ -147,7 +147,7 @@ class ServeDaemon:
             self.engine = MeshResidentEngine(
                 corpus, config or EngineConfig(mode="sharded"),
                 mesh_shape=mesh_shape, capacity=capacity,
-                merge=mesh_merge)
+                merge=mesh_merge, gate_carry=gate_carry)
         else:
             self.engine = ResidentEngine(corpus,
                                          config or EngineConfig(),
@@ -254,6 +254,8 @@ class ServeDaemon:
         req.done.wait()
         if req.kind == "ingest":
             return protocol.ingest_response(req)
+        if req.kind == "corpus":
+            return protocol.corpus_response(req)
         return protocol.query_response(req)
 
     def stats(self) -> Dict[str, Any]:
@@ -265,6 +267,10 @@ class ServeDaemon:
         out = {
             "protocol": protocol.PROTOCOL_VERSION,
             "engine": eng.bucket_stats(),
+            # The fleet prober's consistency probe: rows + rolling
+            # checksum + ingest epoch, comparable across replicas
+            # whatever their resident layouts.
+            "corpus": eng.corpus_state(),
             "admission": self.admission.snapshot(),
             "requests_completed": done,
             "queries_completed":

@@ -196,6 +196,57 @@ class ResidentServingCore:
         reg.gauge("serve.warm_buckets").set(len(self._buckets))
         return per
 
+    # -- corpus signature (fleet consistency checking reads this) -----------
+
+    def _sig_init(self) -> None:
+        """Seed the rolling corpus signature: per-row 64-bit hashes +
+        a position-keyed fold (fleet.consistency). A pure function of
+        (global row id, label, attribute bits) — every resident engine
+        layout reports the same signature for the same corpus, which is
+        what makes cross-replica comparison meaningful."""
+        from dmlp_tpu.fleet import consistency as ccs
+        n = self.n_real
+        self._row_hash = np.zeros(len(self._host_labels), np.uint64)
+        fold = 0
+        if n:
+            self._row_hash[:n] = ccs.row_hashes(
+                self._host_labels[:n], self._host_attrs[:n])
+            fold = ccs.fold_terms(0, self._row_hash[:n])
+        # One tuple, assigned atomically: stats handlers read it while
+        # the batcher thread ingests — a torn (rows, checksum) pair
+        # would manufacture spurious divergences at the router.
+        self._corpus_sig = (n, fold, 0)
+
+    def _sig_update(self, start: int, end: int) -> None:
+        """Fold rows ``[start, end)``'s new content in (O(m): subtract
+        the old terms, add the new — idempotent overwrites are exact
+        no-ops) and bump the ingest epoch."""
+        from dmlp_tpu.fleet import consistency as ccs
+        n0, fold, epoch = self._corpus_sig
+        new_h = ccs.row_hashes(self._host_labels[start:end],
+                               self._host_attrs[start:end])
+        fold = ccs.fold_replace(fold, start,
+                                self._row_hash[start:end], new_h)
+        self._row_hash[start:end] = new_h
+        self._corpus_sig = (max(n0, end), fold, epoch + 1)
+
+    def corpus_state(self) -> Dict[str, int]:
+        """The live corpus signature block the daemon exposes in
+        ``stats`` (and the ``corpus`` wire op echoes): row count,
+        rolling checksum, ingest epoch."""
+        n, fold, epoch = self._corpus_sig
+        return {"rows": n, "checksum": fold, "epoch": epoch}
+
+    def corpus_slice(self, start: int, count: int):
+        """Host rows ``[start, start+count)`` clamped to the resident
+        row count — the ``corpus`` wire op's data source (executed on
+        the batcher thread, so it never races an ingest)."""
+        n = self._corpus_sig[0]
+        start = max(0, min(int(start), n))
+        end = max(start, min(start + int(count), n))
+        return (self._host_labels[start:end].copy(),
+                self._host_attrs[start:end].copy())
+
     # -- corpus max squared norm (boundary-eps / multipass floors) ----------
 
     def _dn_max(self) -> float:
@@ -285,6 +336,7 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
         self._host_labels = np.full(host_rows, -1, np.int32)
         self._host_labels[:n] = corpus.labels
         self.n_real = n
+        self._sig_init()
 
         # -- the resident staged corpus (the streaming paths' view) ----------
         sdt = np_staging_dtype(self._staging)
@@ -493,11 +545,17 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
 
     # -- incremental ingestion ------------------------------------------------
 
-    def ingest(self, labels, attrs) -> int:
-        """Append rows to the resident corpus behind the row-count
-        mask; returns the new row count. The solve programs' shapes are
-        untouched (no recompilation); the fixed-shape row update itself
-        compiles once per power-of-two row-count bucket."""
+    def ingest(self, labels, attrs, start: Optional[int] = None) -> int:
+        """Write rows into the resident corpus behind the row-count
+        mask; returns the new row count. ``start=None`` appends;
+        ``start <= n_real`` writes at that global row position — an
+        IDEMPOTENT row-write keyed by global row id (re-delivering the
+        same rows at the same positions changes nothing, including the
+        corpus signature), which is what makes the fleet's
+        consistency-repair re-ingest safe to race a normal fan-out.
+        The solve programs' shapes are untouched (no recompilation);
+        the fixed-shape row update itself compiles once per
+        power-of-two row-count bucket."""
         labels = np.asarray(labels, np.int32).reshape(-1)
         attrs = np.asarray(attrs, np.float64)
         if attrs.ndim != 2 or attrs.shape[1] != self.num_attrs:
@@ -509,19 +567,25 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
             raise ValueError("labels/attrs row-count mismatch")
         if m == 0:
             return self.n_real
-        start = self.n_real
-        new_n = start + m
-        if new_n > self.capacity_rows:
+        at = self.n_real if start is None else int(start)
+        if at < 0 or at > self.n_real:
+            raise ValueError(
+                f"ingest start {at} beyond resident rows "
+                f"{self.n_real} (row-writes may overwrite or append, "
+                "never leave gaps)")
+        end = at + m
+        new_n = max(self.n_real, end)
+        if end > self.capacity_rows:
             raise CapacityError(
-                f"ingest of {m} rows exceeds capacity "
-                f"{self.capacity_rows} (resident: {start})")
+                f"ingest of {m} rows at {at} exceeds capacity "
+                f"{self.capacity_rows} (resident: {self.n_real})")
         with obs_span("serve.ingest", rows=m, corpus_rows=new_n):
-            self._host_attrs[start:new_n] = attrs
-            self._host_labels[start:new_n] = labels
+            self._host_attrs[at:end] = attrs
+            self._host_labels[at:end] = labels
             self.n_real = new_n
             # Bucketed fixed-shape device update, rebuilt from host
             # state so the pad region rewrites what is already there.
-            mpad = min(shape_bucket(m), self.capacity_rows - start)
+            mpad = min(shape_bucket(m), self.capacity_rows - at)
             mpad = max(mpad, m)
             if (mpad, "u") not in self._ingest_shapes:
                 self._ingest_shapes.add((mpad, "u"))
@@ -529,11 +593,11 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
                     "serve.ingest_compiles").inc(label=str(mpad))
             sdt = np_staging_dtype(self._staging)
             blk = np.ascontiguousarray(
-                self._host_attrs[start:start + mpad], sdt)
-            rng = np.arange(start, start + mpad, dtype=np.int32)
+                self._host_attrs[at:at + mpad], sdt)
+            rng = np.arange(at, at + mpad, dtype=np.int32)
             blk_ids = np.where(rng < new_n, rng, -1).astype(np.int32)
-            blk_labels = self._host_labels[start:start + mpad]
-            s = jax.device_put(np.int32(start))
+            blk_labels = self._host_labels[at:at + mpad]
+            s = jax.device_put(np.int32(at))
             self._d_attrs = _update_rows_2d(
                 self._d_attrs, stage_put(blk, self._staging), s)
             self._d_labels = _update_rows_1d(
@@ -542,7 +606,7 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
                 self._d_ids, jax.device_put(blk_ids), s)
             if self._chunks is not None:
                 cr = self._ex_chunk_rows
-                touched = range(start // cr, -(-new_n // cr))
+                touched = range(at // cr, -(-end // cr))
                 for c in touched:
                     self._restage_chunk(c)
                 # The summaries of exactly the touched blocks must
@@ -553,7 +617,12 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
                 # stale the moment a chunk restages (same shapes, so
                 # the rebuild never recompiles).
                 self._mp_full = None
+            # Overwrites can only RAISE the cached max-sq-norm (the
+            # old row's norm may linger) — conservative: a too-large
+            # dn_max only widens the boundary-repair eps, never
+            # narrows it, so exactness is unaffected.
             self._note_ingested_norms(attrs)
+            self._sig_update(at, end)
         reg = telemetry.registry()
         reg.counter("serve.ingested_rows").inc(m)
         reg.gauge("serve.corpus_rows").set(new_n)

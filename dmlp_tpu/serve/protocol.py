@@ -10,10 +10,22 @@ the micro-batcher coalesces across all of them). Shapes:
   ``checksums`` are the engines' contract FNV-1a values — the replay
   client reassembles the exact contract stdout (``Query N checksum:
   C``) and byte-compares it against the golden oracle.
-- ``{"op": "ingest", "labels": [...], "rows": [[...]]}``
+- ``{"op": "ingest", "labels": [...], "rows": [[...]], "start"?: S}``
   -> ``{"ok": true, "corpus_rows": N}``; capacity overflow is a clean
-  ``ok: false`` with the reason.
-- ``{"op": "stats"}`` -> engine/admission/registry snapshot.
+  ``ok: false`` with the reason. ``start`` makes the write an
+  IDEMPOTENT row-write keyed by global row id (``start <= corpus
+  rows``; re-delivering the same rows at the same positions is a
+  no-op) — the fleet's consistency repair and re-shard replay speak
+  this form; plain appends omit it.
+- ``{"op": "corpus", "start": S, "count": C}`` -> ``{"ok": true,
+  "start": S, "labels": [...], "rows": [[...]], "corpus_rows": N,
+  "checksum": H, "epoch": E}`` — the consistency/replay read side:
+  host rows ``[S, S+C)`` (clamped; ``count`` capped at
+  ``CORPUS_FETCH_MAX`` per line) plus the live corpus signature.
+  ``count: 0`` is the cheap signature probe.
+- ``{"op": "stats"}`` -> engine/admission/registry snapshot (now
+  including the ``corpus`` signature block the fleet prober compares
+  across replicas).
 - ``{"op": "drain"}`` -> acknowledges and initiates the graceful
   drain (the in-band SIGTERM).
 
@@ -38,6 +50,10 @@ PROTOCOL_VERSION = 1
 #: buffers past the cap; the re-check in parse_request covers
 #: non-socket callers.
 MAX_LINE_BYTES = 64 << 20
+
+#: per-request row cap of the ``corpus`` read op (bounds one response
+#: line; replay loops page through larger ranges)
+CORPUS_FETCH_MAX = 65536
 
 
 class ProtocolError(ValueError):
@@ -110,8 +126,24 @@ def parse_request(line: str, num_attrs: int) -> Request:
         if (not isinstance(labels, list) or len(labels) != len(rows)
                 or not all(_is_int(v) for v in labels)):
             raise ProtocolError("'labels' must list one int per row")
+        start = obj.get("start")
+        if start is not None and (not _is_int(start) or start < 0):
+            raise ProtocolError("'start' must be a non-negative int "
+                                "(the global row id of the first row)")
         return Request(kind="ingest", req_id=req_id,
-                       labels=np.asarray(labels, np.int32), attrs=attrs)
+                       labels=np.asarray(labels, np.int32), attrs=attrs,
+                       start=start)
+    if op == "corpus":
+        start = obj.get("start", 0)
+        count = obj.get("count", 0)
+        if not _is_int(start) or start < 0:
+            raise ProtocolError("corpus op 'start' must be a "
+                                "non-negative int")
+        if not _is_int(count) or count < 0:
+            raise ProtocolError("corpus op 'count' must be a "
+                                "non-negative int")
+        return Request(kind="corpus", req_id=req_id, start=start,
+                       count=min(count, CORPUS_FETCH_MAX))
     raise ProtocolError(f"unknown op {op!r}")
 
 
@@ -138,6 +170,15 @@ def ingest_response(req: Request) -> Dict[str, Any]:
         return {"id": req.req_id, "ok": False, "error": req.error}
     return {"id": req.req_id, "ok": True,
             "corpus_rows": int(req.corpus_rows)}
+
+
+def corpus_response(req: Request) -> Dict[str, Any]:
+    """The completed ``corpus`` read -> its wire response (payload is
+    assembled on the batcher thread, so the rows and the signature are
+    one consistent snapshot — never torn by a concurrent ingest)."""
+    if req.error is not None:
+        return {"id": req.req_id, "ok": False, "error": req.error}
+    return {"id": req.req_id, "ok": True, **(req.payload or {})}
 
 
 def encode(obj: Dict[str, Any]) -> bytes:

@@ -1,0 +1,682 @@
+"""Self-healing-fleet tests: corpus signatures + idempotent row-keyed
+ingest, the corpus wire op, checksum-driven consistency repair and its
+quarantine escalation, router revive hysteresis and the dynamic
+replica table, supervisor policy + bounded relaunch + degraded
+fallback, the drain/swap race, scrape staleness stamping, and the
+mesh gate-carry fold order.
+
+The byte-identity oracle everywhere is the float64 golden model — the
+self-healing machinery (signatures, repair, swaps, relaunches) must be
+invisible in the response bytes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.fleet import consistency as ccs
+from dmlp_tpu.fleet import scrape as fscrape
+from dmlp_tpu.fleet.autoscale import (FleetSupervisor, ReplicaSpec,
+                                      target_replicas)
+from dmlp_tpu.fleet.mesh_engine import MeshResidentEngine
+from dmlp_tpu.fleet.reshard import grown_capacity, needs_resplit
+from dmlp_tpu.fleet.router import FleetRouter, Replica
+from dmlp_tpu.golden.fast import knn_golden_fast
+from dmlp_tpu.io.grammar import KNNInput, Params
+from dmlp_tpu.serve import client as sc
+from dmlp_tpu.serve.daemon import ServeDaemon
+from dmlp_tpu.serve.engine import ResidentEngine
+
+
+def make_corpus(n=600, na=5, labels=4, seed=3, spread=50.0) -> KNNInput:
+    rng = np.random.default_rng(seed)
+    return KNNInput(
+        Params(n, 0, na),
+        rng.integers(0, labels, n).astype(np.int32),
+        rng.uniform(0, spread, (n, na)),
+        np.zeros(0, np.int32), np.zeros((0, na)))
+
+
+def golden_for(labels, attrs, q, ks):
+    inp = KNNInput(Params(len(labels), len(ks), attrs.shape[1]),
+                   np.asarray(labels, np.int32), attrs,
+                   np.asarray(ks, np.int32), np.asarray(q, np.float64))
+    return [r.checksum() for r in knn_golden_fast(inp)]
+
+
+def _start_daemon(corpus, **kw):
+    kw.setdefault("tick_s", 0.001)
+    d = ServeDaemon(corpus, kw.pop("config", EngineConfig()), port=0,
+                    **kw)
+    d.start()
+    return d
+
+
+def _sig(d):
+    s = d.engine.corpus_state()
+    return (s["rows"], s["checksum"])
+
+
+# -- corpus signature ----------------------------------------------------------
+
+def test_row_hash_fold_incremental_matches_from_scratch():
+    rng = np.random.default_rng(5)
+    labels = rng.integers(0, 9, 50).astype(np.int32)
+    attrs = rng.uniform(-3, 3, (50, 4))
+    full = ccs.corpus_fold(labels, attrs)
+    # incremental build in two chunks == from-scratch
+    h1 = ccs.row_hashes(labels[:30], attrs[:30])
+    h2 = ccs.row_hashes(labels[30:], attrs[30:])
+    inc = (ccs.fold_terms(0, h1) + ccs.fold_terms(30, h2)) & ((1 << 64) - 1)
+    assert inc == full
+    # overwrite with identical content is a no-op
+    assert ccs.fold_replace(full, 10, h1[10:20], h1[10:20]) == full
+    # overwrite with different content changes it, and replacing back
+    # restores it
+    other = ccs.row_hashes(labels[:10], attrs[:10] + 1.0)
+    changed = ccs.fold_replace(full, 10, h1[10:20], other)
+    assert changed != full
+    assert ccs.fold_replace(changed, 10, other, h1[10:20]) == full
+    # position sensitivity: same rows at different offsets differ
+    assert ccs.fold_terms(0, h1) != ccs.fold_terms(1, h1)
+
+
+def test_diagnose_picks_max_rows_then_majority():
+    a = {"rows": 10, "checksum": 111}
+    b = {"rows": 12, "checksum": 222}
+    assert ccs.diagnose([("r0", a), ("r1", dict(a))]) is None
+    v = ccs.diagnose([("r0", a), ("r1", b)])
+    assert v["reference"] == "r1" and v["divergent"] == ["r0"]
+    # equal rows: the majority signature is the reference
+    c = {"rows": 12, "checksum": 333}
+    v = ccs.diagnose([("r0", b), ("r1", dict(b)), ("r2", c)])
+    assert v["reference"] in ("r0", "r1") and v["divergent"] == ["r2"]
+
+
+def test_signatures_identical_across_engine_layouts():
+    corpus = make_corpus()
+    e1 = ResidentEngine(corpus, EngineConfig())
+    e2 = MeshResidentEngine(corpus, EngineConfig(mode="sharded"),
+                            mesh_shape=(2, 1))
+    s1, s2 = e1.corpus_state(), e2.corpus_state()
+    assert (s1["rows"], s1["checksum"]) == (s2["rows"], s2["checksum"])
+    assert s1["checksum"] == ccs.corpus_fold(corpus.labels,
+                                             corpus.data_attrs)
+    rng = np.random.default_rng(9)
+    newl = rng.integers(0, 4, 7).astype(np.int32)
+    newa = rng.uniform(0, 50, (7, 5))
+    e1.ingest(newl, newa)
+    e2.ingest(newl, newa)
+    s1, s2 = e1.corpus_state(), e2.corpus_state()
+    assert (s1["rows"], s1["checksum"]) == (s2["rows"], s2["checksum"])
+
+
+def test_ingest_start_is_idempotent_and_rejects_gaps():
+    corpus = make_corpus()
+    eng = ResidentEngine(corpus, EngineConfig())
+    rng = np.random.default_rng(11)
+    newl = rng.integers(0, 4, 5).astype(np.int32)
+    newa = rng.uniform(0, 50, (5, 5))
+    eng.ingest(newl, newa)
+    sig0 = eng.corpus_state()
+    # re-delivering the same rows at the same global ids: no-op
+    assert eng.ingest(newl, newa, start=600) == 605
+    sig1 = eng.corpus_state()
+    assert (sig1["rows"], sig1["checksum"]) == (sig0["rows"],
+                                                sig0["checksum"])
+    assert sig1["epoch"] == sig0["epoch"] + 1
+    with pytest.raises(ValueError, match="gap"):
+        eng.ingest(newl, newa, start=700)
+    # overwrite + solve stays golden against the overwritten corpus
+    repl = rng.uniform(0, 50, (5, 5))
+    eng.ingest(newl, repl, start=600)
+    q = rng.uniform(0, 50, (2, 5))
+    ks = np.asarray([4, 6], np.int32)
+    got = [r.checksum() for r in eng.solve_batch(q, ks)]
+    labels = np.concatenate([corpus.labels, newl])
+    attrs = np.vstack([corpus.data_attrs, repl])
+    assert got == golden_for(labels, attrs, q, ks)
+
+
+# -- the corpus wire op --------------------------------------------------------
+
+def test_corpus_wire_op_round_trip_and_signature():
+    corpus = make_corpus()
+    d = _start_daemon(corpus, warm_buckets=[(2, 8)])
+    try:
+        cli = sc.ServeClient(d.port)
+        doc = cli.call({"op": "corpus", "start": 590, "count": 20})
+        assert doc["ok"] and doc["corpus_rows"] == 600
+        assert len(doc["rows"]) == 10          # clamped to n_real
+        assert doc["checksum"] == d.engine.corpus_state()["checksum"]
+        np.testing.assert_array_equal(
+            np.asarray(doc["rows"]), corpus.data_attrs[590:600])
+        # count=0 is the cheap signature probe
+        probe = cli.call({"op": "corpus", "count": 0})
+        assert probe["ok"] and probe["rows"] == []
+        # float64 bits survive the JSON round trip: re-ingesting the
+        # fetched rows at their own ids leaves the signature unchanged
+        r2 = cli.call({"op": "ingest", "labels": doc["labels"],
+                       "rows": doc["rows"], "start": 590})
+        assert r2["ok"] and r2["corpus_rows"] == 600
+        assert d.engine.corpus_state()["checksum"] == doc["checksum"]
+        # malformed starts are protocol errors, not crashes
+        bad = cli.call({"op": "corpus", "start": -1})
+        assert not bad["ok"]
+        bad = cli.call({"op": "ingest", "labels": doc["labels"],
+                        "rows": doc["rows"], "start": True})
+        assert not bad["ok"]
+        cli.close()
+    finally:
+        d.close()
+
+
+# -- consistency repair through the router ------------------------------------
+
+def test_prober_detects_and_repairs_dropped_ingest():
+    corpus = make_corpus()
+    d1 = _start_daemon(corpus, warm_buckets=[(2, 8)])
+    d2 = _start_daemon(corpus, warm_buckets=[(2, 8)])
+    router = FleetRouter([("127.0.0.1", d1.port),
+                          ("127.0.0.1", d2.port)], port=0,
+                         health_interval_s=0.05, divergence_probes=2)
+    router.start()
+    try:
+        rng = np.random.default_rng(13)
+        newl = rng.integers(0, 4, 7).astype(np.int32)
+        newa = rng.uniform(0, 50, (7, 5))
+        # the dropped ingest: rows land on d1 only (as if d2's ingest
+        # faulted mid-fan-out)
+        cli = sc.ServeClient(d1.port)
+        cli.ingest([int(v) for v in newl], newa)
+        cli.close()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = router.stats()
+            if st["consistency"]["repairs"] >= 1:
+                break
+            time.sleep(0.05)
+        assert st["consistency"]["divergences"] >= 1
+        assert st["consistency"]["repairs"] >= 1
+        assert st["consistency"]["repaired_rows"] >= 7
+        assert _sig(d1) == _sig(d2)
+        # the repaired fleet answers the grown oracle from EITHER side
+        labels = np.concatenate([corpus.labels, newl])
+        attrs = np.vstack([corpus.data_attrs, newa])
+        q = rng.uniform(0, 50, (2, 5))
+        ks = [4, 6]
+        want = golden_for(labels, attrs, q, ks)
+        for i in range(4):
+            cli = sc.ServeClient(router.port)
+            r = cli.query(q, ks=ks, req_id=str(i))
+            cli.close()
+            assert r["ok"] and r["checksums"] == want
+    finally:
+        router.close()
+        d1.close()
+        d2.close()
+
+
+def test_unrepairable_content_divergence_quarantines():
+    corpus = make_corpus()
+    ds = [_start_daemon(corpus, warm_buckets=[(2, 8)])
+          for _ in range(3)]
+    router = FleetRouter([("127.0.0.1", d.port) for d in ds], port=0,
+                         health_interval_s=0.05, divergence_probes=2)
+    router.start()
+    try:
+        rng = np.random.default_rng(17)
+        # corrupt ONE replica's tail with different content at equal
+        # row count: the delta is unknowable -> unrepairable
+        bad = rng.uniform(0, 50, (5, 5))
+        lab = [int(v) for v in rng.integers(0, 4, 5)]
+        cli = sc.ServeClient(ds[2].port)
+        r = cli.call({"op": "ingest", "labels": lab,
+                      "rows": bad.tolist(), "start": 595})
+        cli.close()
+        assert r["ok"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = router.stats()
+            if st["consistency"]["unrepairable"] >= 1:
+                break
+            time.sleep(0.05)
+        assert st["consistency"]["unrepairable"] >= 1
+        quar = [x for x in st["replicas"] if x["quarantined"]]
+        assert [q["replica"] for q in quar] == \
+            [f"127.0.0.1:{ds[2].port}"]
+        # quarantine is terminal: healthy probes do not revive it
+        time.sleep(0.3)
+        assert not router.find_replica(
+            f"127.0.0.1:{ds[2].port}").available()
+        # the majority fleet keeps serving golden
+        q = rng.uniform(0, 50, (2, 5))
+        want = golden_for(corpus.labels, corpus.data_attrs, q, [4, 6])
+        cli = sc.ServeClient(router.port)
+        resp = cli.query(q, ks=[4, 6])
+        cli.close()
+        assert resp["ok"] and resp["checksums"] == want
+    finally:
+        router.close()
+        for d in ds:
+            d.close()
+
+
+# -- revive hysteresis ---------------------------------------------------------
+
+def test_revive_hysteresis_requires_consecutive_healthy_probes():
+    rep = Replica("127.0.0.1", 1, revive_probes=3)
+    assert rep.available()
+    rep.probe_fail("boom")
+    assert not rep.available()
+    rep.probe_ok()
+    rep.probe_ok()
+    assert not rep.available()       # 2 < 3 consecutive
+    rep.probe_ok()
+    assert rep.available()           # third consecutive revives
+    # a flap resets the streak
+    rep.probe_fail("boom again")
+    rep.probe_ok()
+    assert not rep.available()
+    rep.probe_fail("flap")
+    rep.probe_ok()
+    rep.probe_ok()
+    assert not rep.available()
+    rep.probe_ok()
+    assert rep.available()
+
+
+def test_router_drain_freeze_is_sticky_against_probes():
+    """The re-shard choreography freezes the old replica with
+    mark(draining=True) while its DAEMON keeps admission open; a
+    health probe reporting draining=False must not un-freeze it (the
+    frozen-corpus invariant of the swap's final catch-up)."""
+    rep = Replica("127.0.0.1", 1)
+    rep.mark(draining=True)
+    rep.probe_ok(draining=False)      # the daemon is not draining
+    assert not rep.available()        # ...but the router's freeze holds
+    rep.mark(draining=False)          # the back-out un-freezes
+    rep.probe_ok(draining=False)
+    assert rep.available()
+    # a daemon-initiated drain still propagates through probes
+    rep.probe_ok(draining=True)
+    assert not rep.available()
+    rep.probe_ok(draining=False)
+    assert rep.available()
+
+
+def test_router_flap_scenario_with_real_probes():
+    corpus = make_corpus()
+    d1 = _start_daemon(corpus, warm_buckets=[(2, 8)])
+    # health_interval huge: the test drives probes deterministically
+    router = FleetRouter([("127.0.0.1", d1.port),
+                          ("127.0.0.1", 1)],   # nothing listens on :1
+                         port=0, health_interval_s=600,
+                         revive_probes=2, repair=False)
+    router.start()
+    try:
+        dead = router.replicas[1]
+        router._probe(dead)
+        assert not dead.available()
+        # "recovery": repoint the dead entry at the live daemon's port
+        dead.port = d1.port
+        router._probe(dead)
+        assert not dead.available()   # first good probe: hysteresis
+        router._probe(dead)
+        assert dead.available()       # second consecutive: revived
+    finally:
+        router.close()
+        d1.close()
+
+
+# -- dynamic replica table + the drain/swap race -------------------------------
+
+def test_swap_race_query_wave_none_lost():
+    """The re-shard routing-table swap under a racing query wave:
+    replacement in, old replica draining then removed, while 12
+    clients fire — every request gets exactly one response, every
+    response is correct or an explicit rejection, none lost."""
+    corpus = make_corpus()
+    d1 = _start_daemon(corpus, warm_buckets=[(2, 8)])
+    d2 = _start_daemon(corpus, warm_buckets=[(2, 8)])
+    router = FleetRouter([("127.0.0.1", d1.port)], port=0,
+                         health_interval_s=0.05)
+    router.start()
+    try:
+        rng = np.random.default_rng(23)
+        q = rng.uniform(0, 50, (2, 5))
+        ks = [4, 6]
+        want = golden_for(corpus.labels, corpus.data_attrs, q, ks)
+        out = [None] * 12
+
+        def worker(i):
+            cli = sc.ServeClient(router.port)
+            try:
+                out[i] = cli.query(q, ks=ks, req_id=str(i))
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(12)]
+        for t in threads[:5]:
+            t.start()
+        # the swap: replacement IN, old frozen, old OUT (the
+        # reshard.execute_resplit choreography at router level)
+        router.add_replica("127.0.0.1", d2.port)
+        router.find_replica(f"127.0.0.1:{d1.port}").mark(draining=True)
+        for t in threads[5:9]:
+            t.start()
+        router.remove_replica(f"127.0.0.1:{d1.port}")
+        for t in threads[9:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert all(r is not None for r in out)        # none lost
+        ok = [r for r in out if r.get("ok")]
+        rejected = [r for r in out if not r.get("ok")]
+        assert all(r["checksums"] == want for r in ok)
+        assert all("rejected" in str(r.get("error", ""))
+                   for r in rejected)
+        assert len(ok) >= 10   # retry keeps nearly everything served
+        names = [r["replica"] for r in router.stats()["replicas"]]
+        assert names == [f"127.0.0.1:{d2.port}"]
+    finally:
+        router.close()
+        d1.close()
+        d2.close()
+
+
+# -- supervisor: policy, crash relaunch, budget exhaustion ---------------------
+
+def test_target_replicas_policy():
+    assert target_replicas([], 2, 1, 4, 4.0, 0.25) == 2
+    assert target_replicas([5, 6, 7], 2, 1, 4, 4.0, 0.25) == 3
+    assert target_replicas([5, 6, 7], 4, 1, 4, 4.0, 0.25) == 4  # capped
+    assert target_replicas([0, 0, 0.1], 3, 1, 4, 4.0, 0.25) == 2
+    assert target_replicas([0, 0, 0], 1, 1, 4, 4.0, 0.25) == 1  # floor
+    assert target_replicas([1, 1, 2], 2, 1, 4, 4.0, 0.25) == 2  # steady
+
+
+class _FakePopen:
+    """Controllable stand-in for a replica daemon process."""
+
+    def __init__(self, pid=0):
+        self.pid = pid
+        self.rc = None
+        self.killed = False
+
+    def poll(self):
+        return self.rc
+
+    def wait(self, timeout=None):
+        if self.rc is None:
+            self.rc = 0
+        return self.rc
+
+    def kill(self):
+        self.killed = True
+        if self.rc is None:
+            self.rc = -9
+
+
+class _FakeProc:
+    def __init__(self, name, port, pid):
+        self.name = name
+        self.proc = _FakePopen(pid)
+        self.ready = {"port": port}
+        self.scrape_port = None
+        self.errlog = ""
+
+
+def _supervised_fixture(daemons, budget):
+    """Router + supervisor whose 'spawn' hands out in-process daemons
+    (deterministic crash/relaunch tests without subprocess latency)."""
+    router = FleetRouter([], allow_empty=True, health_interval_s=600,
+                         repair=False)
+    sup = FleetSupervisor(router, spec=None, min_replicas=1,
+                          max_replicas=4, relaunch_budget=budget,
+                          unhealthy_deadline_s=0)
+    pool = list(daemons)
+
+    def fake_spawn(name, capacity=None):
+        if not pool:
+            raise RuntimeError("fixture pool exhausted")
+        d = pool.pop(0)
+        return _FakeProc(name, d.port, pid=9000 + len(pool))
+
+    sup.spawn_proc = fake_spawn
+    return router, sup
+
+
+def test_supervisor_relaunch_and_budget_exhaustion_degrade():
+    corpus = make_corpus()
+    d1 = _start_daemon(corpus, warm_buckets=[(1, 4)])
+    d2 = _start_daemon(corpus, warm_buckets=[(1, 4)])
+    router, sup = _supervised_fixture([d1, d2], budget=1)
+    try:
+        mr = sup.register(sup.spawn_proc("replica_s01"))
+        assert [r.name for r in router.replica_list()] == \
+            [f"127.0.0.1:{d1.port}"]
+        # crash: the fake process exits nonzero
+        mr.proc.proc.rc = 1
+        sup.poll_once()
+        # relaunched onto d2, budget spent
+        assert sup.relaunch_budget == 0
+        assert [r.name for r in router.replica_list()] == \
+            [f"127.0.0.1:{d2.port}"]
+        assert [e["reason"] for e in sup.retired] == \
+            ["crash: exited rc 1"]
+        assert not sup.degraded
+        # second crash: budget exhausted -> degraded SMALLER fleet,
+        # never a crash loop
+        sup.managed[0].proc.proc.rc = -9
+        sup.poll_once()
+        assert sup.degraded
+        assert sup.managed == []
+        assert len(router.replica_list()) == 0
+        snap = sup.snapshot()
+        assert snap["degraded"] and snap["relaunch_budget_left"] == 0
+    finally:
+        router.close()
+        d1.close()
+        d2.close()
+
+
+def test_supervisor_scale_down_uses_drain_choreography():
+    corpus = make_corpus()
+    d1 = _start_daemon(corpus, warm_buckets=[(1, 4)])
+    d2 = _start_daemon(corpus, warm_buckets=[(1, 4)])
+    router, sup = _supervised_fixture([d1, d2], budget=0)
+    try:
+        sup.register(sup.spawn_proc("replica_s01"))
+        mr2 = sup.register(sup.spawn_proc("replica_s02"))
+        assert len(router.replica_list()) == 2
+        rc = sup.retire(mr2, drain=True, reason="scale_down")
+        assert rc == 0
+        assert len(router.replica_list()) == 1
+        assert sup.retired[-1]["reason"] == "scale_down"
+        # the drained daemon actually received the in-band drain op
+        assert d2._drain_event.is_set()
+        assert not d1._drain_event.is_set()
+    finally:
+        router.close()
+        d1.close()
+        d2.close()
+
+
+def test_reshard_planning_helpers():
+    assert not needs_resplit(100, 256, threshold=0.9)
+    assert needs_resplit(231, 256, threshold=0.9)
+    assert grown_capacity(256, 235) == 512
+    assert grown_capacity(256, 600) >= 1024
+
+
+def test_replica_spec_mesh_flags_set_xla_device_count():
+    spec = ReplicaSpec("corpus.in", ".", flags=["--mesh", "2x1"])
+    env = spec._env()
+    assert "xla_force_host_platform_device_count=2" in env["XLA_FLAGS"]
+    assert "XLA_FLAGS" not in ReplicaSpec("c.in", ".")._env()
+
+
+# -- scrape staleness ----------------------------------------------------------
+
+def test_scrape_cache_stamps_age_and_stale():
+    calls = {"fail": False}
+
+    def fetch(url):
+        if calls["fail"]:
+            raise OSError("down")
+        return "# TYPE x counter\nx_total 4\n# EOF\n"
+
+    clock = [100.0]
+    cache = fscrape.ScrapeCache(clock=lambda: clock[0], fetch=fetch)
+    text, age, stale = cache.fetch("a", "http://x/metrics")
+    assert text and age == 0.0 and not stale
+    calls["fail"] = True
+    clock[0] = 103.5
+    text2, age2, stale2 = cache.fetch("a", "http://x/metrics")
+    assert text2 == text and age2 == pytest.approx(3.5) and stale2
+    # a replica never scraped has nothing to reuse
+    none_text, _age, none_stale = cache.fetch("b", "http://y/metrics")
+    assert none_text is None and none_stale
+    cache.forget("a")
+    t3, _a3, s3 = cache.fetch("a", "http://x/metrics")
+    assert t3 is None and s3
+
+
+def test_router_metrics_text_marks_stale_replica_scrapes():
+    import http.server
+
+    exposition = ("# TYPE serve_requests_completed counter\n"
+                  "serve_requests_completed_total 4\n# EOF\n")
+
+    class _H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = exposition.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _H)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    scrape_port = httpd.server_address[1]
+    corpus = make_corpus()
+    d1 = _start_daemon(corpus, warm_buckets=[(1, 4)])
+    router = FleetRouter([("127.0.0.1", d1.port)],
+                         scrape_ports=[scrape_port], port=0,
+                         health_interval_s=600, repair=False)
+    router.start()
+    try:
+        from dmlp_tpu.obs.telemetry import validate_openmetrics
+        om = router.fleet_metrics_text()
+        assert validate_openmetrics(om) == []
+        assert "fleet_replica_scrape_age_s" in om
+        assert "fleet_replica_scrape_stale" in om
+        line = next(ln for ln in om.splitlines()
+                    if ln.startswith("fleet_replica_scrape_stale"))
+        assert line.endswith(" 0")
+        assert "serve_requests_completed_total 4" in om
+        # the scrape source dies: counters survive via the cache, but
+        # the reuse is STAMPED stale with a nonzero age
+        httpd.shutdown()
+        httpd.server_close()
+        time.sleep(0.05)
+        om2 = router.fleet_metrics_text()
+        assert validate_openmetrics(om2) == []
+        assert "serve_requests_completed_total 4" in om2   # reused
+        line = next(ln for ln in om2.splitlines()
+                    if ln.startswith("fleet_replica_scrape_stale"))
+        assert line.endswith(" 1")
+        age_line = next(ln for ln in om2.splitlines()
+                        if ln.startswith("fleet_replica_scrape_age_s"))
+        assert float(age_line.split()[-1]) >= 0.0
+    finally:
+        router.close()
+        d1.close()
+
+
+# -- mesh gate-carry (ROADMAP follow-on (e)) -----------------------------------
+
+def _banded_mesh_corpus(n=26000, na=4, seed=29):
+    """Norm-banded rows over MULTIPLE per-shard extract chunks (the
+    extract chunk granule is pallas_extract.BLOCK_ROWS = 12800 rows,
+    so real chunk structure needs > 2 * 12800 rows on a 2-shard mesh).
+    The LAST band is far from the others, so queries near it make the
+    late (shard, chunk) blocks the hot ones."""
+    rng = np.random.default_rng(seed)
+    base = rng.uniform(0.0, 1.0, (n, na))
+    scale = np.repeat([1.0, 40.0, 400.0], n // 3 + 1)[:n]
+    attrs = base + scale[:, None]
+    return KNNInput(Params(n, 0, na),
+                    rng.integers(0, 4, n).astype(np.int32), attrs,
+                    np.zeros(0, np.int32), np.zeros((0, na))), attrs
+
+
+def test_mesh_gate_carry_reorders_folds_and_stays_byte_identical():
+    corpus, attrs = _banded_mesh_corpus()
+    cfg = EngineConfig(mode="sharded", select="extract",
+                       use_pallas=True, data_block=12800)
+    ks = np.asarray([6, 6], np.int32)
+    on = MeshResidentEngine(corpus, cfg, mesh_shape=(2, 1),
+                            gate_carry=True)
+    off = MeshResidentEngine(corpus, cfg, mesh_shape=(2, 1),
+                             gate_carry=False)
+    assert on._nchunks > 1           # reordering needs real chunks
+    on.warmup([(2, 6)])
+    off.warmup([(2, 6)])
+    for seed in (1, 2, 3, 4):
+        qq = attrs[-3:-1] + 0.01 * seed    # near the LAST band
+        want = golden_for(corpus.labels, attrs, qq, ks)
+        got_on = [r.checksum() for r in on.solve_batch(qq, ks)]
+        got_off = [r.checksum() for r in off.solve_batch(qq, ks)]
+        assert got_on == got_off == want
+    # Non-vacuity: the per-(shard, chunk) histogram attributed the
+    # winners, and a LATE chunk now folds FIRST (off stays natural).
+    assert on._block_hits.shape == (2, on._nchunks)
+    assert on._block_hits.sum() > 0
+    hot = int(np.argmax(on._block_hits.sum(axis=0)))
+    assert hot != 0                  # the hot band lives in a late chunk
+    assert on._chunk_order()[0] == hot
+    assert off._chunk_order() == list(range(off._nchunks))
+    # ...and the reordered fold is still golden (assert again after
+    # the order actually changed)
+    q = attrs[-3:-1] + 0.01
+    got = [r.checksum() for r in on.solve_batch(q, ks)]
+    assert got == golden_for(corpus.labels, attrs, q, ks)
+    assert on.last_gated_fraction is not None
+    assert on.bucket_stats()["gate_carry"] is True
+    # Per-shard attribution: band-0 queries credit shard 0's row only
+    # (shard 1 holds nothing but the last band's tail).
+    before = on._block_hits.copy()
+    q0 = attrs[:2] + 0.01
+    got0 = [r.checksum() for r in on.solve_batch(
+        q0, np.asarray([4, 4], np.int32))]
+    assert got0 == golden_for(corpus.labels, attrs, q0, [4, 4])
+    delta = on._block_hits - before
+    assert delta[0].sum() > 0
+    assert delta[1].sum() == 0
+
+
+# -- daemon stats carry the corpus block ---------------------------------------
+
+def test_daemon_stats_expose_corpus_signature():
+    corpus = make_corpus()
+    d = _start_daemon(corpus, warm_buckets=[(1, 4)])
+    try:
+        cli = sc.ServeClient(d.port)
+        st = cli.stats()["stats"]
+        cli.close()
+        assert st["corpus"]["rows"] == 600
+        assert st["corpus"]["checksum"] == \
+            ccs.corpus_fold(corpus.labels, corpus.data_attrs)
+        assert st["corpus"]["epoch"] == 0
+    finally:
+        d.close()
