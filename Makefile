@@ -7,8 +7,8 @@ CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
 .PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
 	perf-gate check lint chaos-smoke telemetry-smoke serve-smoke \
-	race-smoke prune-smoke fleet-smoke fleet-chaos-smoke serve-bench \
-	fleet-bench clean
+	race-smoke prune-smoke fleet-smoke fleet-chaos-smoke \
+	fleet-trace-smoke serve-bench fleet-bench clean
 
 all: native
 
@@ -19,7 +19,7 @@ native/_fastparse.so: native/fastparse.cpp
 
 test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint \
 	chaos-smoke telemetry-smoke serve-smoke race-smoke prune-smoke \
-	fleet-smoke fleet-chaos-smoke
+	fleet-smoke fleet-chaos-smoke fleet-trace-smoke
 	python -m pytest tests/ -q
 
 # Static analysis + runtime-sanitizer smoke (README "Static analysis &
@@ -267,6 +267,26 @@ fleet-chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/fleet_chaos_smoke.py \
 	  --out outputs/fleet_chaos \
 	  --record outputs/fleet_chaos/FLEET_CHAOS_SMOKE.jsonl
+
+# Request-tracing smoke (README "Request tracing & tail attribution"):
+# five proofs over a REAL 2-replica fleet on CPU. (1) Untraced arm:
+# responses carry no rid and checksum golden. (2) Traced arm (x2 + x8
+# open-loop replay, rid-stamped client + traced router + replicas):
+# every rid echoed, contract checksums BYTE-IDENTICAL to the untraced
+# arm. (3) merge_traces --fleet clock-aligns the four per-process
+# traces and reconstructs one x8 request client->route->hop->
+# queue->coalesce->solve->finalize->write, phase sums reconciling with
+# client latency within tolerance. (4) check_trace --fleet passes the
+# merged trace and REJECTS a tampered one (fabricated retry hop).
+# (5) tail_attrib names each level's dominant phase and its
+# fleet/<level>/phase/*_p99_ms RunRecords ledger-ingest and perf-gate
+# (TAILATTRIB_r16.jsonl is the committed round).
+fleet-trace-smoke:
+	mkdir -p outputs/fleet_trace
+	rm -f outputs/fleet_trace/TAILATTRIB.jsonl
+	JAX_PLATFORMS=cpu python tools/fleet_trace_smoke.py \
+	  --out outputs/fleet_trace \
+	  --record outputs/fleet_trace/TAILATTRIB.jsonl
 
 # Fleet SLO bench (not in `make test`; emits the FLEET_rNN ledger
 # rounds): 2 replicas (one mesh-resident) + router, the paced trace

@@ -95,6 +95,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "(0 = ephemeral, announced in the ready file)")
     p.add_argument("--record", metavar="FILE", default=None,
                    help="append the final fleet-router RunRecord here")
+    p.add_argument("--trace", metavar="FILE", default=None,
+                   help="write the router's Chrome-trace JSON "
+                        "(rid-tagged route/hop spans) here on drain; "
+                        "merge with the replicas' --trace files via "
+                        "tools/merge_traces.py --fleet")
     p.add_argument("--health-interval-s", type=float, default=1.0)
     p.add_argument("--request-timeout-s", type=float, default=600.0)
     p.add_argument("--revive-probes", type=int, default=1,
@@ -162,7 +167,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          telemetry_port=args.telemetry_port,
                          revive_probes=args.revive_probes,
                          repair=args.repair == "on",
-                         allow_empty=supervised)
+                         allow_empty=supervised,
+                         trace_path=args.trace)
     supervisor = None
     if supervised:
         from dmlp_tpu.fleet.autoscale import FleetSupervisor, ReplicaSpec

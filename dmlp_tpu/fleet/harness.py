@@ -93,7 +93,8 @@ def await_replica(fp: FleetProc, timeout_s: float = 600.0) -> Dict:
 
 
 def spawn_router(out_dir: str, replicas: List[FleetProc],
-                 record: Optional[str] = None) -> FleetProc:
+                 record: Optional[str] = None,
+                 flags: Optional[List[str]] = None) -> FleetProc:
     ready = os.path.join(out_dir, "router_ready.json")
     errlog = os.path.join(out_dir, "router.err")
     if os.path.exists(ready):
@@ -105,7 +106,7 @@ def spawn_router(out_dir: str, replicas: List[FleetProc],
     cmd = [sys.executable, "-m", "dmlp_tpu.fleet",
            "--replicas", endpoints, "--scrape-ports", scrapes,
            "--port", "0", "--ready-file", ready,
-           "--telemetry-port", "0"]
+           "--telemetry-port", "0"] + (flags or [])
     if record:
         cmd += ["--record", record]
     with open(errlog, "w") as ef:

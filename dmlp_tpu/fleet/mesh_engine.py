@@ -314,7 +314,8 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
                 or inp.params.num_queries == 0):
             return None, None
         r, _ = self.mesh.devices.shape
-        with obs_span("fleet.prune_score", blocks=r * self._nchunks):
+        with obs_span("fleet.prune_score", blocks=r * self._nchunks,
+                      **self._rid_args()):
             keep, stats = osum.prune_mask(inp.query_attrs, inp.ks,
                                           self._summ,
                                           staging=self._staging)
@@ -433,7 +434,7 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         with obs_span("fleet.solve_resident", qpad=entry.qpad, kcap=k,
                       chunks=self._nchunks, scheduled=len(schedule),
                       impl=impl, mesh=[r, c],
-                      carry=self.gate_carry):
+                      carry=self.gate_carry, **self._rid_args()):
             for t, live_col in schedule:
                 lv = self._ones_live if live_col is None \
                     else jax.device_put(np.asarray(live_col, np.int32),
@@ -473,7 +474,8 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         merge_fn = self._chunk_merge_fn(k)
         obs_counters.record_dispatch(merge_fn, (cd, ci, self._lab_dev),
                                      site="fleet.chunk_merge")
-        with obs_span("fleet.merge", mesh=[r, c], kc=k) as sp:
+        with obs_span("fleet.merge", mesh=[r, c], kc=k,
+                      **self._rid_args()) as sp:
             top = merge_fn(cd, ci, self._lab_dev)
             sp.fence(top.dists)
         return top
@@ -485,10 +487,12 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         aggregate winner count (hottest first) when gate carry-over is
         on, natural otherwise. Stable sort: cold chunks keep their
         natural relative order."""
-        if not self.gate_carry:
-            return list(range(self._nchunks))
-        heat = self._block_hits.sum(axis=0)
-        return list(np.argsort(-heat[:self._nchunks], kind="stable"))
+        with obs_span("fleet.fold_schedule", chunks=self._nchunks,
+                      carry=self.gate_carry, **self._rid_args()):
+            if not self.gate_carry:
+                return list(range(self._nchunks))
+            heat = self._block_hits.sum(axis=0)
+            return list(np.argsort(-heat[:self._nchunks], kind="stable"))
 
     def _after_batch(self, results: List[QueryResult]) -> None:
         """Cross-request gate bookkeeping (the single-chip resident
@@ -528,7 +532,7 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         d_attrs, d_labels, d_ids = self._mono
         q_dev = self._stage_queries(inp, entry.qpad)
         with obs_span("fleet.solve_stream", qpad=entry.qpad,
-                      kcap=entry.kcap):
+                      kcap=entry.kcap, **self._rid_args()):
             top = self.solve_global(d_attrs, d_labels, d_ids, q_dev,
                                     kmax=entry.kb)
         dense = self.n_real * self.num_attrs \
@@ -565,13 +569,14 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
             top = self._solve_resident_stream(inp, entry)
         telemetry.sample_memory_now()
         self.last_repairs = 0
-        with obs_span("fleet.fetch"):
+        with obs_span("fleet.fetch", **self._rid_args()):
             od, ol, oi = resilient_get((top.dists, top.labels, top.ids),
                                        site="sharded.fetch")
             dists = np.asarray(od, np.float64)[:nq]
             labels = ol[:nq]
             ids = oi[:nq]
-        with obs_span("fleet.finalize", exact=self.config.exact):
+        with obs_span("fleet.finalize", exact=self.config.exact,
+                      **self._rid_args()):
             results = finalize_host(dists, labels, ids, inp.ks,
                                     inp.query_attrs, inp.data_attrs,
                                     exact=self.config.exact)

@@ -59,6 +59,8 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from dmlp_tpu.obs import telemetry
+from dmlp_tpu.obs import trace as obs_trace
+from dmlp_tpu.obs.trace import span as obs_span
 from dmlp_tpu.resilience.retry import classify
 
 #: request-line cap mirrored from the daemon protocol
@@ -275,7 +277,8 @@ class FleetRouter:
                  telemetry_port: Optional[int] = None,
                  revive_probes: int = 1, repair: bool = True,
                  divergence_probes: int = 2,
-                 allow_empty: bool = False):
+                 allow_empty: bool = False,
+                 trace_path: Optional[str] = None):
         scrape_ports = scrape_ports or [None] * len(replicas)
         if len(scrape_ports) != len(replicas):
             raise ValueError("one scrape port per replica (or none)")
@@ -324,6 +327,14 @@ class FleetRouter:
         self._telemetry_port = telemetry_port
         self._telemetry_httpd = None
         self._t_ready: Optional[float] = None
+        # Request tracing opt-in (same contract as the daemon's):
+        # process-wide Tracer + the clock-sync marker the fleet merge
+        # aligns on; written at drain/close.
+        self.trace_path = trace_path
+        self._tracer = None
+        if trace_path:
+            self._tracer = obs_trace.install(obs_trace.Tracer())
+            self._tracer.sync_instant("fleet.clock_sync")
 
     # -- the dynamic replica table ---------------------------------------------
 
@@ -430,9 +441,22 @@ class FleetRouter:
             self._server.shutdown()
         self._wait_inflight_drained()
         self._stop_health.set()
+        self._write_trace()
         if self._telemetry_httpd is not None:
             self._telemetry_httpd.shutdown()
         self._server.server_close()
+
+    def _write_trace(self) -> None:
+        if self._tracer is None:
+            return
+        try:
+            self._tracer.write(self.trace_path,
+                               process_name=f"router:{self.port}")
+        except Exception:  # check: no-retry — traces never kill a drain
+            pass
+        if obs_trace.active() is self._tracer:
+            obs_trace.uninstall()
+        self._tracer = None
 
     def close(self) -> None:
         """Abrupt teardown for tests (no drain propagation)."""
@@ -442,6 +466,7 @@ class FleetRouter:
         self._stop_health.set()
         if self._server_thread is not None:
             self._server.shutdown()
+        self._write_trace()
         if self._telemetry_httpd is not None:
             self._telemetry_httpd.shutdown()
         self._server.server_close()
@@ -569,12 +594,16 @@ class FleetRouter:
         """One client line -> (response line, close-connection?)."""
         reg = telemetry.registry()
         t0 = time.monotonic()
+        rid = ""
         try:
             obj = json.loads(raw)
             op = obj.get("op", "query") if isinstance(obj, dict) \
                 else "invalid"
+            if isinstance(obj, dict):
+                rid = str(obj.get("rid", "") or "")
         except ValueError:
             op = "query"    # let a daemon produce the protocol error
+        rargs = {"rid": rid} if rid else {}
         reg.counter("fleet.requests").inc(label=str(op))
         if op == "stats":
             return encode({"ok": True, "stats": self.stats()}), False
@@ -583,66 +612,103 @@ class FleetRouter:
             return encode({"ok": True, "draining": True}), True
         if self._draining_now():
             reg.counter("fleet.rejected").inc(label="draining")
+            # A rejected request still gets its terminal router span —
+            # the merged causal tree must explain every rid, shed ones
+            # included.
+            with obs_span("fleet.route", op=str(op),
+                          outcome="rejected_draining", **rargs):
+                pass
             return encode({"ok": False, "error": "rejected: draining",
                            "draining": True}), True
-        if op == "ingest":
-            resp = self._route_ingest(raw)
-        else:
-            resp = self._route_query(raw)
+        with obs_span("fleet.route", op=str(op), **rargs) as sp:
+            if op == "ingest":
+                resp = self._route_ingest(raw, rid)
+                sp.set(outcome="done")
+            else:
+                resp, hops, outcome = self._route_query(raw, rid)
+                sp.set(outcome=outcome, hops=hops)
         reg.histogram("fleet.request_latency_ms", unit="ms").observe(
-            (time.monotonic() - t0) * 1e3)
+            (time.monotonic() - t0) * 1e3, exemplar=rid or None)
         return resp, False
 
-    def _route_query(self, raw: bytes) -> bytes:
+    def _route_query(self, raw: bytes,
+                     rid: str = "") -> Tuple[bytes, int, str]:
         """Bounded retry-on-replica-failure: transport failures and
         replica-local draining rejections move on to the next replica
         (queries are idempotent reads — exactly one response either
-        way); everything else relays verbatim."""
+        way); everything else relays verbatim. Returns (response line,
+        hops, outcome): ``hops`` counts replica attempts, recorded in
+        the ``fleet.retry_hops`` histogram and — for retried requests
+        only, so the single-hop relay stays byte-verbatim — surfaced
+        as ``"hops"`` in the response envelope (the re-encode is
+        byte-stable: the daemon used the same sort_keys encoder)."""
         reg = telemetry.registry()
         tried: set = set()
         last_error = "no healthy replica"
+        rargs = {"rid": rid} if rid else {}
+        hops = 0
         for _attempt in range(max(len(self.replicas), 1)):
             rep = self._pick(tried)
             if rep is None:
                 break
             tried.add(rep)
-            try:
-                resp = rep.call(raw, timeout_s=self.request_timeout_s)
-            except OSError as e:
-                # The resilience classification decides retryability:
-                # connection refused/reset/EOF/timeouts all classify
-                # transient — mark the replica down (the prober revives
-                # it) and retry on a healthy one.
-                kind = classify(e)
-                rep.mark(healthy=False, error=str(e))
-                reg.counter("fleet.replica_failures").inc(
-                    label=rep.name)
-                last_error = f"replica {rep.name}: {e}"
-                if kind not in ("transient", "oom"):
-                    break
-                reg.counter("fleet.retries").inc(label="failure")
-                continue
-            try:
-                doc = json.loads(resp)
-            except ValueError:
-                doc = {}
-            err = str(doc.get("error", ""))
-            if doc.get("ok") is False and "draining" in err:
-                # Replica-local shutdown, not fleet backpressure.
-                rep.mark(draining=True)
-                reg.counter("fleet.retries").inc(label="draining")
-                last_error = f"replica {rep.name}: draining"
-                continue
-            if doc.get("ok") is False and err.startswith("rejected"):
-                # Admission shed: the explicit backpressure signal,
-                # propagated unretried.
-                reg.counter("fleet.rejected").inc(label="admission")
-            return resp
+            hops += 1
+            with obs_span("fleet.hop", attempt=hops, replica=rep.name,
+                          **rargs) as hop:
+                try:
+                    resp = rep.call(raw,
+                                    timeout_s=self.request_timeout_s)
+                except OSError as e:
+                    # The resilience classification decides
+                    # retryability: connection refused/reset/EOF/
+                    # timeouts all classify transient — mark the
+                    # replica down (the prober revives it) and retry
+                    # on a healthy one.
+                    kind = classify(e)
+                    rep.mark(healthy=False, error=str(e))
+                    reg.counter("fleet.replica_failures").inc(
+                        label=rep.name)
+                    last_error = f"replica {rep.name}: {e}"
+                    hop.set(outcome=f"error_{kind}")
+                    if kind not in ("transient", "oom"):
+                        break
+                    reg.counter("fleet.retries").inc(label="failure")
+                    continue
+                try:
+                    doc = json.loads(resp)
+                except ValueError:
+                    doc = {}
+                err = str(doc.get("error", ""))
+                if doc.get("ok") is False and "draining" in err:
+                    # Replica-local shutdown, not fleet backpressure.
+                    rep.mark(draining=True)
+                    reg.counter("fleet.retries").inc(label="draining")
+                    last_error = f"replica {rep.name}: draining"
+                    hop.set(outcome="draining")
+                    continue
+                if doc.get("ok") is False and err.startswith("rejected"):
+                    # Admission shed: the explicit backpressure signal,
+                    # propagated unretried.
+                    reg.counter("fleet.rejected").inc(label="admission")
+                    outcome = "rejected_admission"
+                else:
+                    outcome = "ok" if doc.get("ok") else "relayed"
+                hop.set(outcome=outcome)
+            reg.histogram("fleet.retry_hops").observe(
+                hops, exemplar=rid or None)
+            if hops > 1 and doc:
+                doc["hops"] = hops
+                resp = encode(doc)
+            return resp, hops, outcome
         reg.counter("fleet.rejected").inc(label="unavailable")
-        return encode({"ok": False,
-                       "error": f"rejected: {last_error}"})
+        reg.histogram("fleet.retry_hops").observe(
+            max(hops, 1), exemplar=rid or None)
+        out = {"ok": False, "error": f"rejected: {last_error}"}
+        if hops > 1:
+            out["hops"] = hops
+        return encode(out), hops, "unavailable"
 
-    def _route_ingest(self, raw: bytes) -> bytes:
+    def _route_ingest(self, raw: bytes, rid: str = "") -> bytes:
         """Fan-out to every available replica; ALL must accept (a
         partial ingest forks the fleet corpus — the response names the
         divergent replicas instead of hiding them)."""
@@ -654,18 +720,25 @@ class FleetRouter:
                            "error": "rejected: no healthy replica"})
         oks: List[bytes] = []
         failures: List[str] = []
+        rargs = {"rid": rid} if rid else {}
         for rep in targets:
-            try:
-                resp = rep.call(raw, timeout_s=self.request_timeout_s)
-                doc = json.loads(resp)
-            except (OSError, ValueError) as e:
-                rep.mark(healthy=False, error=str(e))
-                failures.append(f"{rep.name}: {e}")
-                continue
-            if doc.get("ok"):
-                oks.append(resp)
-            else:
-                failures.append(f"{rep.name}: {doc.get('error')}")
+            with obs_span("fleet.hop", replica=rep.name, fanout=True,
+                          **rargs) as hop:
+                try:
+                    resp = rep.call(raw,
+                                    timeout_s=self.request_timeout_s)
+                    doc = json.loads(resp)
+                except (OSError, ValueError) as e:
+                    rep.mark(healthy=False, error=str(e))
+                    failures.append(f"{rep.name}: {e}")
+                    hop.set(outcome="error_transport")
+                    continue
+                if doc.get("ok"):
+                    oks.append(resp)
+                    hop.set(outcome="ok")
+                else:
+                    failures.append(f"{rep.name}: {doc.get('error')}")
+                    hop.set(outcome="error_replica")
         if failures or not oks:
             reg.counter("fleet.ingest_divergence").inc()
             return encode({"ok": False, "error":

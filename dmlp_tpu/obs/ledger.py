@@ -289,6 +289,16 @@ def _runrecord_series_name(rec: RunRecord, key: str) -> str:
         return f"telemetry{cfg_tag}/{key}"
     if rec.kind == "serve":
         return f"serve/{key}"
+    if rec.kind == "tailattrib":
+        # Tail-latency attribution (tools/tail_attrib.py over a merged
+        # fleet trace): ``fleet/<level>/phase/<metric>`` so the
+        # per-phase p99 contribution at each offered-load level gates
+        # alongside the end-to-end fleet/<level>/ SLO series it
+        # decomposes.
+        lvl = rec.config.get("level") if isinstance(rec.config, dict) \
+            else None
+        tag = f"/{lvl}" if lvl else ""
+        return f"fleet{tag}/phase/{key}"
     if rec.kind == "fleet":
         # Open-loop SLO records (fleet.loadgen) + the router snapshot:
         # one ``fleet/<level>/<metric>`` series per offered-load level

@@ -179,6 +179,10 @@ class Histogram:
         self._sum = 0.0
         self._min = math.inf
         self._max = -math.inf
+        # bucket index -> (exemplar id, value): the LAST exemplar-
+        # carrying observation per bucket, so a p99 bucket links back
+        # to one reconstructable request (rid) in the merged trace.
+        self._exemplars: Dict[int, Tuple[str, float]] = {}
 
     @staticmethod
     def bucket_index(v: float) -> int:
@@ -192,7 +196,7 @@ class Histogram:
             i -= 1
         return min(i, _NBUCKETS)
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         if not math.isfinite(v):
             return          # a NaN sample must not poison the quantiles
@@ -203,6 +207,13 @@ class Histogram:
             self._sum += v
             self._min = min(self._min, v)
             self._max = max(self._max, v)
+            if exemplar is not None:
+                self._exemplars[i] = (str(exemplar), v)
+
+    def exemplars(self) -> Dict[int, Tuple[str, float]]:
+        """bucket index -> (exemplar id, observed value) snapshot."""
+        with self._lock:
+            return dict(self._exemplars)
 
     @property
     def count(self) -> int:
@@ -377,11 +388,21 @@ class Registry:
                                  f" {_om_num(v)}")
             else:                                   # Histogram
                 prev = 0
-                for bound, cum in m.bucket_counts():
+                exem = m.exemplars()
+                for bi, (bound, cum) in enumerate(m.bucket_counts()):
                     if cum == prev and bound != math.inf:
                         continue    # sparse render: skip empty prefixes
                     le = "+Inf" if bound == math.inf else _om_num(bound)
                     lines.append(f'{name}_bucket{{le="{le}"}} {cum}')
+                    # Exemplar as a comment line the validator (and any
+                    # plain-Prometheus scraper) tolerates: the last rid
+                    # observed into this bucket, so a tail bucket links
+                    # back to one reconstructable request in the trace.
+                    ex = exem.get(bi)
+                    if ex is not None and cum > prev:
+                        lines.append(
+                            f'# EXEMPLAR {name}_bucket{{le="{le}"}} '
+                            f'{_om_escape(ex[0])} {_om_num(ex[1])}')
                     prev = cum
                 lines.append(f"{name}_sum {_om_num(m.sum)}")
                 lines.append(f"{name}_count {m.count}")
@@ -407,7 +428,7 @@ _SAMPLE_RE = re.compile(
     r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (?P<value>\S+)$")
 _META_RE = re.compile(
     r"^# (TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram)"
-    r"|HELP .*|EOF)$")
+    r"|HELP .*|EXEMPLAR .*|EOF)$")
 
 
 def validate_openmetrics(text: str) -> List[str]:

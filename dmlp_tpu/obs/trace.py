@@ -223,6 +223,18 @@ class Tracer:
         self._append({"name": name, "ph": "C", "ts": ts, "pid": self._pid,
                       "args": {k: float(v) for k, v in series.items()}})
 
+    def sync_instant(self, name: str, **args) -> None:
+        """A clock-sync marker pairing one perf_counter read with one
+        wall-clock read taken back-to-back. Unbarriered fleet processes
+        have no shared event to align on (unlike the dist collective
+        barrier), but they do share the host's wall clock — the merge
+        recovers per-process offsets from the (ts, unix_ms) pair, so
+        the two reads must bracket nothing in between."""
+        t = _clock()
+        unix_ms = time.time() * 1e3
+        self.instant(name, ts=(t - self._epoch) * 1e6,
+                     unix_ms=unix_ms, **args)
+
     def _complete(self, name: str, t0: float, t1: float,
                   args: Dict[str, Any]) -> None:
         ev = {"name": name, "ph": "X",
@@ -313,3 +325,28 @@ def counter(name: str, **series) -> None:
     t = _active
     if t is not None:
         t.counter(name, **series)
+
+
+def sinks_active() -> bool:
+    """True when completed spans go anywhere (Tracer or telemetry
+    observer). Request-phase instrumentation that must be zero-cost
+    when untraced gates its clock reads on this."""
+    return _active is not None or _span_observer is not None
+
+
+def complete_at(name: str, t0: float, t1: float, **args) -> None:
+    """Record a span from caller-measured ``perf_counter`` endpoints.
+
+    The ``with span():`` form can only bracket one thread's stack
+    frame; request phases (queue wait, scheduled-fire latency) start
+    on one thread and end on another, so the producer stamps ``t0``,
+    the consumer stamps ``t1``, and this records the interval as a
+    regular complete event — same tracer + observer fan-out as Span
+    exit, no-op when no sink is installed."""
+    t = _active
+    if t is not None:
+        t._complete(name, t0, t1, args)
+        return
+    cb = _span_observer
+    if cb is not None:
+        cb(name, max((t1 - t0) * 1e3, 0.0), args)

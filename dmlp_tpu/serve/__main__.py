@@ -98,6 +98,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "series) here — final on drain, periodic with "
                         "--snapshot-every-s")
     p.add_argument("--snapshot-every-s", type=float, default=0.0)
+    p.add_argument("--trace", metavar="FILE", default=None,
+                   help="write a Chrome-trace JSON of request-phase "
+                        "spans here on drain (rid-tagged; merge the "
+                        "fleet's files with tools/merge_traces.py "
+                        "--fleet)")
     p.add_argument("--ready-file", metavar="PATH", default=None)
     p.add_argument("--faults", metavar="FILE", default=None,
                    help="fault-injection schedule "
@@ -152,7 +157,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         tick_s=args.tick_ms / 1e3, telemetry_path=args.telemetry,
         telemetry_port=args.telemetry_port, record_path=args.record,
         snapshot_every_s=args.snapshot_every_s, warm_buckets=warm,
-        mesh_shape=mesh_shape, mesh_merge=args.mesh_merge)
+        mesh_shape=mesh_shape, mesh_merge=args.mesh_merge,
+        trace_path=args.trace)
     try:
         daemon.start()
         sys.stderr.write(f"dmlp_tpu.serve: ready port={daemon.port} "

@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from dmlp_tpu.obs import telemetry
+from dmlp_tpu.obs import trace as obs_trace
 from dmlp_tpu.obs.trace import span as obs_span
 from dmlp_tpu.resilience import inject as rs_inject
 from dmlp_tpu.serve.admission import ACCEPT, AdmissionController
@@ -44,6 +45,9 @@ class Request:
 
     kind: str
     req_id: str = ""
+    rid: str = ""                                 # trace request id ("" =
+    #                                               untraced; never invented
+    #                                               server-side)
     query_attrs: Optional[np.ndarray] = None      # (nq, na) float64
     ks: Optional[np.ndarray] = None               # (nq,) int32
     labels: Optional[np.ndarray] = None           # ingest: (m,) int32
@@ -53,6 +57,11 @@ class Request:
     count: Optional[int] = None                   # corpus read length
     debug: bool = False                           # echo neighbors/dists
     t_enqueue: float = dataclasses.field(default_factory=time.monotonic)
+    # Same instant in the tracer's clock domain: request-phase spans
+    # (queue/coalesce/...) are cross-thread intervals stitched from
+    # perf_counter reads via trace.complete_at.
+    t_enqueue_pc: float = dataclasses.field(
+        default_factory=time.perf_counter)
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event)
     results: Optional[List] = None                # QueryResults (local ids)
@@ -91,6 +100,10 @@ class MicroBatcher:
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self.batches = 0
+        # perf_counter at which the consumer woke for the current
+        # collect cycle — the queue-wait / coalesce-wait boundary for
+        # phase spans. Consumer-thread-private.
+        self._wake_pc = 0.0
 
     # -- producer side ---------------------------------------------------------
 
@@ -106,6 +119,7 @@ class MicroBatcher:
         rule R703 enforces this split statically)."""
         if req.kind == "query":
             kmax = int(req.ks.max()) if req.nq else 0
+            a0 = time.perf_counter() if obs_trace.sinks_active() else 0.0
             pre = self.admission.precheck(req.nq, kmax)
             with self._cond:
                 decision = self.admission.decide_queued(
@@ -118,6 +132,15 @@ class MicroBatcher:
                     telemetry.registry().gauge("serve.queue_depth").set(
                         self._queued_queries)
                     self._cond.notify()
+            if a0:
+                # Runs on the handler thread CONCURRENTLY with the
+                # queue wait, so it is reported for attribution but
+                # excluded from the phase sum the merge reconciles
+                # (see tools/merge_traces.py --fleet).
+                args = {"rid": req.rid} if req.rid else {}
+                obs_trace.complete_at("serve.phase.admission", a0,
+                                      time.perf_counter(),
+                                      verdict=decision["verdict"], **args)
             if decision["verdict"] != ACCEPT:
                 req.complete(error=f"rejected: {decision['reason']}")
             return decision
@@ -184,6 +207,7 @@ class MicroBatcher:
                 self._cond.wait(timeout=0.1)
             if not self._queue:
                 return []
+            self._wake_pc = time.perf_counter()
             if not self._stop and self.tick_s > 0 \
                     and self._queued_queries < self.max_batch_queries:
                 self._cond.wait(timeout=self.tick_s)
@@ -224,7 +248,27 @@ class MicroBatcher:
             else:
                 self._execute_batch(batch)
 
+    def _phase(self, name: str, t0: float, t1: float, rid: str,
+               **args) -> None:
+        """One request-phase span through the complete_at seam (tracer
+        AND the PR 9 telemetry observer, so ``serve.phase.*.ms``
+        histograms stay live); rid-tagged when the request carried
+        one. Callers gate on sinks_active()."""
+        if rid:
+            args["rid"] = rid
+        obs_trace.complete_at(name, t0, max(t0, t1), **args)
+
     def _execute_ingest(self, req: Request) -> None:
+        e0 = 0.0
+        if obs_trace.sinks_active():
+            e0 = time.perf_counter()
+            # check: allow-concurrency=R702 — _wake_pc is written in
+            # _collect and read here, both only on the batcher thread
+            # (_run is the sole caller of either); the write holds
+            # _cond only because _collect already does.
+            self._phase("serve.phase.queue", req.t_enqueue_pc,
+                        max(req.t_enqueue_pc, self._wake_pc), req.rid,
+                        kind="ingest")
         try:
             # The fleet chaos harness's dropped-ingest site: a
             # transient fault here fails THIS replica's ingest before
@@ -238,10 +282,21 @@ class MicroBatcher:
             req.complete(corpus_rows=rows)
         except Exception as e:  # check: no-retry — surfaced to the client
             req.complete(error=f"{type(e).__name__}: {e}")
+        if e0:
+            self._phase("serve.phase.ingest", e0, time.perf_counter(),
+                        req.rid, ok=req.error is None)
 
     def _execute_corpus(self, req: Request) -> None:
         """Serve one ``corpus`` read on the batcher thread: the rows
         and the signature are one snapshot (no ingest can interleave)."""
+        e0 = 0.0
+        if obs_trace.sinks_active():
+            e0 = time.perf_counter()
+            # check: allow-concurrency=R702 — batcher-thread-only read
+            # (see _execute_ingest).
+            self._phase("serve.phase.queue", req.t_enqueue_pc,
+                        max(req.t_enqueue_pc, self._wake_pc), req.rid,
+                        kind="corpus")
         try:
             state = self.engine.corpus_state()
             labels, attrs = self.engine.corpus_slice(req.start or 0,
@@ -257,6 +312,9 @@ class MicroBatcher:
             req.complete()
         except Exception as e:  # check: no-retry — surfaced to the client
             req.complete(error=f"{type(e).__name__}: {e}")
+        if e0:
+            self._phase("serve.phase.corpus", e0, time.perf_counter(),
+                        req.rid, ok=req.error is None)
 
     def _execute_batch(self, batch: List[Request]) -> None:
         reg = telemetry.registry()
@@ -265,18 +323,30 @@ class MicroBatcher:
         ks = np.concatenate([r.ks for r in batch])
         qpad, _ = self.engine.bucket_shape(
             total, int(ks.max()) if total else 1)
+        tracing = obs_trace.sinks_active()
+        rids = ",".join(r.rid for r in batch if r.rid) if tracing else ""
         t0 = time.perf_counter()
         try:
             with obs_span("serve.micro_batch", requests=len(batch),
-                          queries=total, qpad=qpad):
-                results = self.engine.solve_batch(q, ks)
+                          queries=total, qpad=qpad,
+                          **({"rids": rids} if rids else {})):
+                if rids:
+                    # Single consumer thread: the engine reads this
+                    # inside solve_batch to rid-tag its internal spans.
+                    self.engine.trace_rids = rids
+                try:
+                    results = self.engine.solve_batch(q, ks)
+                finally:
+                    if rids:
+                        self.engine.trace_rids = None
         except Exception as e:  # check: no-retry — batch fails visibly,
             reg.counter("serve.batch_errors").inc()  # daemon survives
             msg = f"{type(e).__name__}: {e}"
             for r in batch:
                 r.complete(error=msg)
             return
-        ms = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        ms = (t1 - t0) * 1e3
         with self._cond:
             # handler threads read `batches` through daemon.stats()
             # while this consumer increments it — guard the write so
@@ -298,4 +368,24 @@ class MicroBatcher:
             reg.counter("serve.requests_completed").inc()
             reg.counter("serve.queries_completed").inc(r.nq)
             reg.histogram("serve.request_latency_ms", unit="ms").observe(
-                (time.monotonic() - r.t_enqueue) * 1e3)
+                (time.monotonic() - r.t_enqueue) * 1e3,
+                exemplar=r.rid or None)
+            if tracing:
+                # Per-request phase decomposition. queue ends when the
+                # consumer woke (clamped: a request that arrived during
+                # the coalesce tick has zero queue wait); coalesce runs
+                # to solve start; the full batch solve interval is
+                # attributed to EVERY coalesced request (documented
+                # overlap — the phases of one rid tile its wall time,
+                # they do not sum across rids).
+                # check: allow-concurrency=R702 — batcher-thread-only
+                # read (see _execute_ingest).
+                q1 = min(max(self._wake_pc, r.t_enqueue_pc), t0)
+                self._phase("serve.phase.queue", r.t_enqueue_pc, q1,
+                            r.rid)
+                self._phase("serve.phase.coalesce", q1, t0, r.rid,
+                            requests=len(batch))
+                self._phase("serve.phase.solve", t0, t1, r.rid,
+                            queries=total, qpad=qpad)
+                self._phase("serve.phase.finalize", t1,
+                            time.perf_counter(), r.rid)

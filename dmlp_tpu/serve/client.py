@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from dmlp_tpu.io.grammar import KNNInput, Params
+from dmlp_tpu.obs import trace as obs_trace
 
 TRACE_SCHEMA = 1
 
@@ -237,7 +238,10 @@ def replay(port: int, header: Dict[str, Any],
 def replay_open_loop(port: int, header: Dict[str, Any],
                      requests: List[Dict[str, Any]], speed: float = 1.0,
                      host: str = "127.0.0.1",
-                     timeout_s: float = 600.0) -> List[Dict[str, Any]]:
+                     timeout_s: float = 600.0,
+                     rid_prefix: Optional[str] = None,
+                     level: Optional[float] = None
+                     ) -> List[Dict[str, Any]]:
     """Paced OPEN-LOOP replay: every request fires AT its trace
     ``t_ms`` offset (divided by ``speed`` — ``speed=2`` offers 2× the
     trace's load) on its own connection, REGARDLESS of completions —
@@ -252,28 +256,50 @@ def replay_open_loop(port: int, header: Dict[str, Any],
     clock starts so the fire loop does no per-request numeric work.
     Returns one dict per request in trace order: the wire response (or
     an ``ok: false`` error for connection failures) plus ``client_ms``
-    and ``lag_ms``."""
+    and ``lag_ms``.
+
+    ``rid_prefix`` turns on request tracing: every payload carries
+    ``rid = f"{rid_prefix}{i}"`` (pre-encoded) plus a ``trace`` context
+    stamped AT FIRE TIME — the pre-encoded body is held open (no
+    closing brace) so the fire loop appends the stamped tail by byte
+    concatenation, never re-encoding the query rows. Each response
+    additionally emits a ``client.request`` span (scheduled fire ->
+    response parsed, i.e. exactly ``client_ms``) on the process's
+    trace sinks, rid-tagged, with ``level`` attached for tail
+    attribution."""
+    traced = bool(rid_prefix)
     payloads = []
     for i, req in enumerate(requests):
         q = materialize_queries(req, header)
         ks = request_ks(req)
         obj = {"op": "query", "id": str(i), "queries": q.tolist(),
                "ks": [int(v) for v in ks]}
-        payloads.append((json.dumps(obj) + "\n").encode())
+        if traced:
+            obj["rid"] = f"{rid_prefix}{i}"
+            # sans closing brace: the fire-time trace tail completes it
+            payloads.append(json.dumps(obj)[:-1].encode())
+        else:
+            payloads.append((json.dumps(obj) + "\n").encode())
     out: List[Optional[Dict[str, Any]]] = [None] * len(requests)
     t0 = time.monotonic() + 0.05    # small runway so request 0 is paced
+    t0_wall = time.time() + (t0 - time.monotonic())
 
     def worker(i: int) -> None:
-        sched = t0 + float(requests[i].get("t_ms", 0)) / 1e3 \
+        off_s = float(requests[i].get("t_ms", 0)) / 1e3 \
             / max(speed, 1e-9)
+        sched = t0 + off_s
         delay = sched - time.monotonic()
         if delay > 0:
             time.sleep(delay)
         lag_ms = (time.monotonic() - sched) * 1e3
+        data = payloads[i]
+        if traced:
+            data += (',"trace":{"sched_unix_ms":%.3f,"lag_ms":%.3f}}\n'
+                     % ((t0_wall + off_s) * 1e3, lag_ms)).encode()
         try:
             with socket.create_connection((host, port),
                                           timeout=timeout_s) as sock:
-                sock.sendall(payloads[i])
+                sock.sendall(data)
                 with sock.makefile("rb") as rf:
                     line = rf.readline()
             if not line:
@@ -283,6 +309,19 @@ def replay_open_loop(port: int, header: Dict[str, Any],
             resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
         resp["client_ms"] = round((time.monotonic() - sched) * 1e3, 3)
         resp["lag_ms"] = round(lag_ms, 3)
+        if traced and obs_trace.sinks_active():
+            # Recover the scheduled fire instant in the tracer's
+            # perf_counter domain (both clocks are CLOCK_MONOTONIC
+            # rates): the span IS client_ms, queue lag included.
+            t1p = time.perf_counter()
+            t0p = t1p - (time.monotonic() - sched)
+            args = {"rid": f"{rid_prefix}{i}",
+                    "lag_ms": round(lag_ms, 3),
+                    "ok": bool(resp.get("ok")),
+                    "hops": int(resp.get("hops", 1))}
+            if level is not None:
+                args["level"] = level
+            obs_trace.complete_at("client.request", t0p, t1p, **args)
         out[i] = resp
 
     threads = [threading.Thread(target=worker, args=(i,), daemon=True)

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from dmlp_tpu.config import EngineConfig
 from dmlp_tpu.io.grammar import KNNInput
 from dmlp_tpu.obs import telemetry
+from dmlp_tpu.obs import trace as obs_trace
 from dmlp_tpu.serve import protocol
 from dmlp_tpu.serve.admission import AdmissionController
 from dmlp_tpu.serve.batching import MicroBatcher, Request
@@ -90,8 +91,15 @@ class _Handler(socketserver.StreamRequestHandler):
                     # batcher
                     resp = {"ok": False,
                             "error": f"{type(e).__name__}: {e}"}
+                w0 = (time.perf_counter()
+                      if obs_trace.sinks_active() else 0.0)
                 self.wfile.write(protocol.encode(resp))
                 self.wfile.flush()
+                if w0:
+                    rid = resp.get("rid", "")
+                    obs_trace.complete_at(
+                        "serve.phase.write", w0, time.perf_counter(),
+                        **({"rid": rid} if rid else {}))
             finally:
                 daemon._track_inflight(-1)
             if resp.get("draining"):
@@ -121,10 +129,19 @@ class ServeDaemon:
                  snapshot_every_s: float = 0.0,
                  warm_buckets: Optional[List[Tuple[int, int]]] = None,
                  mesh_shape: Optional[Tuple[int, int]] = None,
-                 mesh_merge: str = "allgather"):
+                 mesh_merge: str = "allgather",
+                 trace_path: Optional[str] = None):
         self.corpus = corpus
         self.record_path = record_path
         self.snapshot_every_s = snapshot_every_s
+        # Request tracing opt-in: install a process-wide Tracer and
+        # stamp the clock-sync marker the fleet merge aligns this
+        # process's spans on. Written at drain/close.
+        self.trace_path = trace_path
+        self._tracer = None
+        if trace_path:
+            self._tracer = obs_trace.install(obs_trace.Tracer())
+            self._tracer.sync_instant("fleet.clock_sync")
         self.session = None
         if telemetry_path or telemetry_port is not None:
             # handle_signals stays ON (the session owns the handler);
@@ -384,11 +401,24 @@ class ServeDaemon:
         # drain that exits mid-write loses the response on the floor.
         self._wait_inflight_drained()
         self._append_record()
+        self._write_trace()
         if self.session is not None:
             self.session.set_sigterm_drain(None)
             self.session.close()     # writes the final snapshot
         self._restore_sigterm()
         self._server.server_close()
+
+    def _write_trace(self) -> None:
+        if self._tracer is None:
+            return
+        try:
+            self._tracer.write(self.trace_path,
+                               process_name=f"serve:{self.port}")
+        except Exception:  # check: no-retry — traces never kill a drain
+            pass
+        if obs_trace.active() is self._tracer:
+            obs_trace.uninstall()
+        self._tracer = None
 
     def close(self) -> None:
         """Abrupt teardown for tests (no drain semantics)."""
@@ -396,6 +426,7 @@ class ServeDaemon:
         self.admission.draining = True
         self._server.shutdown()
         self.batcher.stop(drain=False)
+        self._write_trace()
         if self.session is not None:
             self.session.set_sigterm_drain(None)
             self.session.close()

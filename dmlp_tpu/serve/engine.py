@@ -48,7 +48,7 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -138,6 +138,18 @@ class ResidentServingCore:
     term names differ per model), and names its cache-invalidation
     state in :meth:`resident_state_key`.
     """
+
+    #: comma-joined rids of the micro-batch currently in solve_batch —
+    #: set/cleared by the batcher (single consumer thread, plain
+    #: attribute) so solve-internal spans can self-tag; None (the
+    #: class default) whenever untraced.
+    trace_rids: Optional[str] = None
+
+    def _rid_args(self) -> Dict[str, Any]:
+        """Span-args rider carrying the current batch's rids — empty
+        (and allocation-only) when untraced."""
+        t = self.trace_rids
+        return {"rids": t} if t else {}
 
     def _bucket_entry(self, nq: int, kmax: int):
         """The bucket for (nq, kmax), building (and counting) it on
@@ -663,7 +675,7 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
                              self._staging)
         self._last_select = self._stream_select
         with obs_span("serve.solve_stream", qpad=entry.qpad,
-                      kcap=entry.kcap) as sp:
+                      kcap=entry.kcap, **self._rid_args()) as sp:
             out: TopK = entry.stream(self._d_attrs, self._d_labels,
                                      self._d_ids, q_blocks)
             sp.fence(out.dists)
@@ -698,7 +710,7 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
                 sd["counts"], sd["nmin"], sd["nmax"], sd["lo"],
                 sd["hi"], sd["dn_max"], sd["eps_rel"], sd["eps_cancel"])
         with obs_span("serve.prune_score", blocks=self._ex_nchunks,
-                      qpad=entry.qpad):
+                      qpad=entry.qpad, **self._rid_args()):
             obs_counters.record_dispatch(osum.score_blocks, args,
                                          site="serve.prune_score")
             mask = osum.score_blocks(*args)
@@ -746,7 +758,8 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
         self.last_extract_impl = impl
         with obs_span("serve.solve_extract", qpad=entry.qpad,
                       kcap=entry.kcap, impl=impl,
-                      carry=self.gate_carry, scheduled=len(order)):
+                      carry=self.gate_carry, scheduled=len(order),
+                      **self._rid_args()):
             for c in order:
                 lo = c * cr
                 nr = min(self.n_real - lo, cr)
@@ -832,7 +845,8 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
         od = oi = None
         throttle = ChunkThrottle()
         with obs_span("serve.solve_multipass", qpad=entry.qpad,
-                      kcap=kcap, passes=npasses, impl=impl):
+                      kcap=kcap, passes=npasses, impl=impl,
+                      **self._rid_args()):
             for c in range(self._ex_nchunks):
                 lo = c * cr
                 nr = min(n - lo, cr)
@@ -892,11 +906,13 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
         """Fold order over the resident chunks: hottest (most past
         winners) first when gate carry-over is on, natural otherwise.
         Stable sort: cold chunks keep their natural relative order."""
-        idx = range(self._ex_nchunks)
-        if not self.gate_carry:
-            return list(idx)
-        return list(np.argsort(-self._block_hits[:self._ex_nchunks],
-                               kind="stable"))
+        with obs_span("serve.fold_schedule", chunks=self._ex_nchunks,
+                      carry=self.gate_carry, **self._rid_args()):
+            idx = range(self._ex_nchunks)
+            if not self.gate_carry:
+                return list(idx)
+            return list(np.argsort(-self._block_hits[:self._ex_nchunks],
+                                   kind="stable"))
 
     # -- SingleChipEngine seam overrides --------------------------------------
 

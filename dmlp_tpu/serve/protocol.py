@@ -31,6 +31,18 @@ the micro-batcher coalesces across all of them). Shapes:
 
 Rejections and errors are ``{"ok": false, "error": "..."}`` — the
 connection stays usable.
+
+Tracing envelope: every request MAY carry ``"rid"`` (an opaque
+request-id string the client stamps at fire time) and ``"trace"`` (a
+small dict of client-side context, e.g. the scheduled-fire wall
+clock). Both are optional and advisory: a replica echoes a non-empty
+``rid`` back in the response and tags its internal phase spans with
+it, the fleet router annotates its routing spans with it, and
+``tools/merge_traces.py --fleet`` stitches the per-process spans into
+one causal tree keyed on it. Requests without ``rid`` serve exactly
+as before, responses without it are byte-identical to the pre-rid
+wire format, and no clock is read for it unless a trace sink is
+installed — the contract channel never sees the difference.
 """
 
 from __future__ import annotations
@@ -83,6 +95,7 @@ def parse_request(line: str, num_attrs: int) -> Request:
     if op in ("stats", "drain"):
         return obj
     req_id = str(obj.get("id", ""))
+    rid = str(obj.get("rid", "") or "")
     if op == "query":
         queries = obj.get("queries")
         if not isinstance(queries, list) or not queries:
@@ -108,8 +121,9 @@ def parse_request(line: str, num_attrs: int) -> Request:
                 raise ProtocolError("'ks' must list one positive int "
                                     "per query row")
             ks_arr = np.asarray(ks, np.int32)
-        return Request(kind="query", req_id=req_id, query_attrs=q,
-                       ks=ks_arr, debug=bool(obj.get("debug")))
+        return Request(kind="query", req_id=req_id, rid=rid,
+                       query_attrs=q, ks=ks_arr,
+                       debug=bool(obj.get("debug")))
     if op == "ingest":
         rows = obj.get("rows")
         labels = obj.get("labels")
@@ -130,7 +144,7 @@ def parse_request(line: str, num_attrs: int) -> Request:
         if start is not None and (not _is_int(start) or start < 0):
             raise ProtocolError("'start' must be a non-negative int "
                                 "(the global row id of the first row)")
-        return Request(kind="ingest", req_id=req_id,
+        return Request(kind="ingest", req_id=req_id, rid=rid,
                        labels=np.asarray(labels, np.int32), attrs=attrs,
                        start=start)
     if op == "corpus":
@@ -142,15 +156,24 @@ def parse_request(line: str, num_attrs: int) -> Request:
         if not _is_int(count) or count < 0:
             raise ProtocolError("corpus op 'count' must be a "
                                 "non-negative int")
-        return Request(kind="corpus", req_id=req_id, start=start,
-                       count=min(count, CORPUS_FETCH_MAX))
+        return Request(kind="corpus", req_id=req_id, rid=rid,
+                       start=start, count=min(count, CORPUS_FETCH_MAX))
     raise ProtocolError(f"unknown op {op!r}")
+
+
+def _rid_echo(req: Request, out: Dict[str, Any]) -> Dict[str, Any]:
+    """Echo a non-empty rid; rid-less responses keep the exact pre-rid
+    key set (the traced/untraced byte-identity contract)."""
+    if req.rid:
+        out["rid"] = req.rid
+    return out
 
 
 def query_response(req: Request, debug: bool = False) -> Dict[str, Any]:
     """The completed query Request -> its wire response."""
     if req.error is not None:
-        return {"id": req.req_id, "ok": False, "error": req.error}
+        return _rid_echo(req, {"id": req.req_id, "ok": False,
+                               "error": req.error})
     out: Dict[str, Any] = {
         "id": req.req_id, "ok": True,
         "labels": [int(r.predicted_label) for r in req.results],
@@ -162,14 +185,15 @@ def query_response(req: Request, debug: bool = False) -> Dict[str, Any]:
                             for r in req.results]
         out["dists"] = [[float(d) for d in r.neighbor_dists]
                         for r in req.results]
-    return out
+    return _rid_echo(req, out)
 
 
 def ingest_response(req: Request) -> Dict[str, Any]:
     if req.error is not None:
-        return {"id": req.req_id, "ok": False, "error": req.error}
-    return {"id": req.req_id, "ok": True,
-            "corpus_rows": int(req.corpus_rows)}
+        return _rid_echo(req, {"id": req.req_id, "ok": False,
+                               "error": req.error})
+    return _rid_echo(req, {"id": req.req_id, "ok": True,
+                           "corpus_rows": int(req.corpus_rows)})
 
 
 def corpus_response(req: Request) -> Dict[str, Any]:
@@ -177,8 +201,10 @@ def corpus_response(req: Request) -> Dict[str, Any]:
     assembled on the batcher thread, so the rows and the signature are
     one consistent snapshot — never torn by a concurrent ingest)."""
     if req.error is not None:
-        return {"id": req.req_id, "ok": False, "error": req.error}
-    return {"id": req.req_id, "ok": True, **(req.payload or {})}
+        return _rid_echo(req, {"id": req.req_id, "ok": False,
+                               "error": req.error})
+    return _rid_echo(req, {"id": req.req_id, "ok": True,
+                           **(req.payload or {})})
 
 
 def encode(obj: Dict[str, Any]) -> bytes:

@@ -362,6 +362,11 @@ def test_router_replica_crash_mid_request_bounded_retry():
         assert all(r["ok"] for r in responses), responses
         assert all(r["checksums"] == golden for r in responses)
         assert crasher.queries_seen >= 1   # the crasher WAS tried
+        # The retried request SAYS it was retried: the envelope
+        # surfaces the replica-attempt count, and only retried
+        # responses carry it (single-hop relays stay byte-verbatim).
+        assert any(r.get("hops", 0) >= 2 for r in responses), responses
+        assert all(r["hops"] >= 2 for r in responses if "hops" in r)
         st = router.stats()
         crashed = next(rep for rep in st["replicas"]
                        if rep["replica"].endswith(str(crasher.port)))

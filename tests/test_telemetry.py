@@ -283,6 +283,42 @@ class TestOpenMetrics:
         h.observe(5e-05)
         assert validate_openmetrics(r.to_openmetrics()) == []
 
+    def test_exemplars_render_and_validate(self):
+        # The last exemplar-carrying observation per bucket is exposed
+        # as a '# EXEMPLAR' comment line after its bucket sample —
+        # tolerated by the validator, linking a tail bucket back to
+        # one rid in the merged fleet trace.
+        r = Registry()
+        h = r.histogram("fleet.request_latency_ms", unit="ms")
+        h.observe(2.0, exemplar="x2-0")
+        h.observe(2.1, exemplar="x2-5")   # same bucket: last wins
+        h.observe(400.0, exemplar="x8-3")
+        h.observe(7.0)                    # no exemplar: no comment
+        text = r.to_openmetrics()
+        assert validate_openmetrics(text) == []
+        lines = text.splitlines()
+        ex = [ln for ln in lines if ln.startswith("# EXEMPLAR ")]
+        assert len(ex) == 2, text
+        assert any("x2-5" in ln for ln in ex)
+        assert all("x2-0" not in ln for ln in ex)
+        assert any("x8-3" in ln for ln in ex)
+        # each exemplar comment follows its bucket sample line
+        for ln in ex:
+            bucket = ln.split(" ", 2)[2].rsplit(" ", 2)[0]
+            i = lines.index(ln)
+            assert lines[i - 1].startswith(bucket + " "), (bucket, ln)
+
+    def test_exemplar_free_exposition_is_byte_stable(self):
+        # observe() without the kwarg must render exactly as before —
+        # the exemplar seam is opt-in per observation.
+        r1, r2 = Registry(), Registry()
+        for reg in (r1, r2):
+            h = reg.histogram("span.latency_ms", unit="ms")
+            for v in (1.0, 5.0, 250.0):
+                h.observe(v)
+        assert r1.to_openmetrics() == r2.to_openmetrics()
+        assert "# EXEMPLAR" not in r1.to_openmetrics()
+
     def test_http_endpoint_serves_metrics(self):
         import urllib.request
         telemetry.REGISTRY.counter("http.hits").inc(5)

@@ -33,11 +33,37 @@ do not fail: emulated/CI ranks legitimately skew (sequential launch),
 and the gate for real clusters is a policy call made downstream
 (``--fail-on-straggler`` opts in).
 
+``--fleet MERGED.json [--json] [--min-reconciled F]`` validates a
+merged FLEET trace (tools/merge_traces.py --fleet output) — the
+request-scoped causal contract:
+
+- per-process structure: process_name metadata, a ``fleet.clock_sync``
+  marker per process, non-negative monotonic aligned timestamps;
+- rid uniqueness: at most one ``client.request`` and one
+  ``fleet.route`` span per rid (a reused rid would stitch two requests
+  into one causal tree);
+- parentage: every ``fleet.hop`` and every rid-tagged
+  ``serve.phase.*`` span hangs under a ``fleet.route`` root for its
+  rid (no orphan subtrees), and no rid mixes query hops with ingest
+  fan-out hops (no cross-op rid reuse);
+- retry accounting: the number of attempt-numbered hop spans equals
+  the route span's ``hops`` arg, and attempt >= 2 hops appear ONLY on
+  requests whose route records >= 2 hops (retry spans on a
+  non-retried request would be fabricated causality);
+- per-rid phase order: each rid's replica phases start in the
+  canonical queue -> coalesce -> solve -> finalize -> write order
+  (``serve.phase.admission`` is exempt: it runs on the handler thread
+  concurrent with the queue wait);
+- when the merge embedded client-side reconcile verdicts: the
+  reconciled fraction must reach ``--min-reconciled`` (default 0.9).
+
 Exit 0 on success, 1 with a message naming the first violated invariant.
 
 Usage: python tools/check_trace.py TRACE.json METRICS.jsonl
        python tools/check_trace.py --dist MERGED.json [--ranks N]
            [--json] [--fail-on-straggler]
+       python tools/check_trace.py --fleet MERGED.json [--json]
+           [--min-reconciled F]
 """
 
 from __future__ import annotations
@@ -251,8 +277,186 @@ def check_dist_trace(path: str, expect_ranks: int = None,
           f"{counts}")
 
 
+_PHASE_ORDER = ("queue", "coalesce", "solve", "finalize", "write")
+
+
+def check_fleet_trace(path: str, emit_json: bool = False,
+                      min_reconciled: float = 0.9) -> None:
+    """Request-scoped causal contract of a merged fleet trace
+    (tools/merge_traces.py --fleet output); see module docstring."""
+    def say(msg: str) -> None:
+        print(msg, file=sys.stderr if emit_json else sys.stdout)
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        fail(f"merged fleet trace {path} unreadable: {e}")
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        fail(f"merged fleet trace {path}: traceEvents missing or empty")
+    fleet = doc.get("fleet")
+    if not isinstance(fleet, dict):
+        fail(f"merged fleet trace {path}: no fleet block — was it "
+             "merged with merge_traces --fleet?")
+
+    # -- per-process structure ------------------------------------------
+    procs = fleet.get("processes", {})
+    meta_by_pid, sync_by_pid, ts_by_pid = {}, set(), {}
+    client_spans, routes, hop_spans, phase_spans = {}, {}, {}, {}
+    for e in events:
+        pid = e.get("pid")
+        if pid is None:
+            fail(f"{path}: event {e} has no pid")
+        ph = e.get("ph")
+        if ph == "M":
+            meta_by_pid.setdefault(pid, set()).add(e.get("name"))
+            continue
+        if "ts" in e:
+            if not isinstance(e["ts"], (int, float)) or e["ts"] < 0:
+                fail(f"{path}: pid {pid} event {e.get('name')} has bad "
+                     f"ts {e.get('ts')!r} (negative or non-numeric "
+                     "after alignment)")
+            ts_by_pid.setdefault(pid, []).append(e["ts"])
+        if ph == "i" and e.get("name") == "fleet.clock_sync":
+            sync_by_pid.add(pid)
+        if ph != "X":
+            continue
+        a = e.get("args", {})
+        rid = a.get("rid")
+        name = e.get("name", "")
+        if name == "client.request" and rid:
+            client_spans.setdefault(rid, []).append(e)
+        elif name == "fleet.route" and rid:
+            routes.setdefault(rid, []).append(e)
+        elif name == "fleet.hop" and rid:
+            hop_spans.setdefault(rid, []).append(e)
+        elif name.startswith("serve.phase.") and rid:
+            phase_spans.setdefault(rid, {}).setdefault(
+                name[len("serve.phase."):], []).append(e)
+    for pname, info in sorted(procs.items()):
+        pid = info.get("pid")
+        if "process_name" not in meta_by_pid.get(pid, set()):
+            fail(f"{path}: process {pname} (pid {pid}) has no "
+                 "process_name metadata event")
+        if pid not in sync_by_pid:
+            fail(f"{path}: process {pname} (pid {pid}) has no "
+                 "fleet.clock_sync marker (alignment unverifiable)")
+        ts = ts_by_pid.get(pid, [])
+        if any(b < a for a, b in zip(ts, ts[1:])):
+            fail(f"{path}: process {pname} timestamps are not "
+                 "monotonic in the merged event order")
+
+    # -- rid uniqueness + parentage -------------------------------------
+    for rid, evs in sorted(client_spans.items()):
+        if len(evs) > 1:
+            fail(f"{path}: rid {rid!r} has {len(evs)} client.request "
+                 "spans — rids must be unique per request")
+    for rid, evs in sorted(routes.items()):
+        if len(evs) > 1:
+            fail(f"{path}: rid {rid!r} has {len(evs)} fleet.route "
+                 "spans — rids must be unique per request")
+    orphans = sorted(set(hop_spans) - set(routes))
+    if orphans:
+        fail(f"{path}: fleet.hop span(s) for rid(s) {orphans[:5]} have "
+             "no fleet.route root — orphan causal subtree")
+    orphans = sorted(set(phase_spans) - set(routes))
+    if orphans:
+        fail(f"{path}: serve.phase.* span(s) for rid(s) {orphans[:5]} "
+             "have no fleet.route root — orphan causal subtree")
+
+    # -- retry accounting -----------------------------------------------
+    retried = []
+    for rid, evs in sorted(routes.items()):
+        route_args = evs[0].get("args", {})
+        hops = hop_spans.get(rid, [])
+        attempts = [h for h in hops
+                    if "attempt" in h.get("args", {})]
+        fanouts = [h for h in hops if h.get("args", {}).get("fanout")]
+        if attempts and fanouts:
+            fail(f"{path}: rid {rid!r} mixes query retry hops and "
+                 "ingest fan-out hops — rid reused across ops")
+        declared = route_args.get("hops")
+        if declared is not None:
+            if len(attempts) != int(declared):
+                fail(f"{path}: rid {rid!r} route declares hops="
+                     f"{declared} but carries {len(attempts)} "
+                     "attempt-numbered fleet.hop span(s)")
+            if int(declared) >= 2:
+                retried.append(rid)
+        if any(int(h["args"]["attempt"]) >= 2 for h in attempts) \
+                and (declared is None or int(declared) < 2):
+            fail(f"{path}: rid {rid!r} carries an attempt>=2 retry hop "
+                 f"but its route records hops={declared!r} — retry "
+                 "span on a non-retried request")
+
+    # -- per-rid phase order --------------------------------------------
+    for rid, phases in sorted(phase_spans.items()):
+        starts = [(p, min(e["ts"] for e in phases[p]))
+                  for p in _PHASE_ORDER if p in phases]
+        for (pa, ta), (pb, tb) in zip(starts, starts[1:]):
+            if tb < ta:
+                fail(f"{path}: rid {rid!r} phase {pb!r} starts before "
+                     f"{pa!r} ({tb} < {ta} us) — canonical phase order "
+                     "violated")
+
+    # -- reconcile verdicts ---------------------------------------------
+    reconcile = fleet.get("reconcile", {})
+    frac = reconcile.get("fraction")
+    if "reconcile_unavailable" in reconcile:
+        say(f"check_trace: note — phase-sum reconcile unavailable: "
+            f"{reconcile['reconcile_unavailable']}")
+    elif frac is not None and frac < min_reconciled:
+        fail(f"{path}: phase-sum reconcile fraction {frac} < "
+             f"{min_reconciled} ({reconcile.get('n_reconciled')}/"
+             f"{reconcile.get('n_requests')} requests within "
+             f"tolerance; tol_abs={reconcile.get('tol_abs_ms')}ms "
+             f"tol_rel={reconcile.get('tol_rel')})")
+
+    if emit_json:
+        print(json.dumps({
+            "trace": path,
+            "processes": procs,
+            "rids": len(routes),
+            "client_spans": len(client_spans),
+            "retried_rids": retried,
+            "phased_rids": len(phase_spans),
+            "reconcile": reconcile or None,
+            "clock": doc.get("clock"),
+        }, sort_keys=True))
+    say(f"check_trace: merged fleet trace ok — "
+        f"{len(procs)} processes, {len(routes)} routed rid(s), "
+        f"{len(retried)} retried, reconcile "
+        f"{reconcile.get('n_reconciled')}/{reconcile.get('n_requests')}"
+        f" (fraction {frac})")
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--fleet":
+        rest = argv[1:]
+        emit_json = "--json" in rest
+        if emit_json:
+            rest.remove("--json")
+        min_rec = 0.9
+        if "--min-reconciled" in rest:
+            i = rest.index("--min-reconciled")
+            try:
+                min_rec = float(rest[i + 1])
+            except (IndexError, ValueError):
+                print("check_trace: --min-reconciled expects a float",
+                      file=sys.stderr)
+                print(__doc__, file=sys.stderr)
+                return 2
+            del rest[i:i + 2]
+        if len(rest) != 1:
+            print(__doc__, file=sys.stderr)
+            return 2
+        check_fleet_trace(rest[0], emit_json=emit_json,
+                          min_reconciled=min_rec)
+        print("check_trace: all fleet-trace invariants hold",
+              file=sys.stderr if emit_json else sys.stdout)
+        return 0
     if argv and argv[0] == "--dist":
         rest = argv[1:]
         expect = None

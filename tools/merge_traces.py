@@ -43,6 +43,33 @@ epoch (``time.perf_counter`` is per-process) and its rank as the Perfetto
 Usage: python tools/merge_traces.py DIR [-o MERGED.json] [--no-align]
        [--straggler-threshold X]
 Exit 0 on success; 1 with a message naming the violated invariant.
+
+**Fleet mode** (``--fleet``): merge one serving fleet's rid-tagged
+traces instead of dist rank files. Inputs in DIR are
+``trace-router.json`` (required), ``trace-replicaNN.json`` (>= 1), and
+optionally ``trace-client.json`` (the loadgen-side tracer). Fleet
+processes share no barrier, so alignment uses each process's
+``fleet.clock_sync`` instant — a back-to-back (perf_counter, wall
+clock) pair — against the router's: localhost processes share the wall
+clock, so the recovered offsets are sub-millisecond. Merged pids are
+reassigned (client 0, router 1, replica i -> 10+i) and every span is
+stitched by its ``rid`` arg into a per-request causal tree (client
+fire -> route -> hops -> replica phases). When the client trace is
+present the merge reconciles, per rid, the replica-side phase sum
+(queue + coalesce + solve + finalize + write) against the
+client-measured scheduled-fire latency minus its recorded pacing lag:
+
+    residual_ms = client_ms - lag_ms - phase_sum_ms
+
+The residual is the un-phased remainder (connect/parse/router relay +
+clock-rate noise), so the tolerance is one-sided-wide:
+``-tol_clock_ms <= residual <= tol_abs_ms + tol_rel * client_ms``.
+Durations are clock-OFFSET invariant, so the check survives imperfect
+alignment; ``tol_clock_ms`` only absorbs perf_counter rate noise on
+the negative side. The ``serve.phase.admission`` span runs on the
+handler thread CONCURRENT with the queue wait and is therefore
+reported but EXCLUDED from the sum. Verdicts are embedded per rid in
+the merged ``fleet`` block for ``tools/check_trace.py --fleet``.
 """
 
 from __future__ import annotations
@@ -338,6 +365,196 @@ def merge(trace_dir: str, align: bool = True,
     }
 
 
+# -- fleet mode ---------------------------------------------------------------
+
+#: the replica-side request phases the reconcile sums; one rid's phases
+#: tile ITS OWN wall time (solve is the full micro-batch interval,
+#: attributed to every coalesced rid) — never sum across rids.
+FLEET_PHASES = ("queue", "coalesce", "solve", "finalize", "write")
+
+
+def fleet_sync(doc, pname: str):
+    """-> (ts_us, unix_us) of the process's fleet.clock_sync marker."""
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") == "i" and e.get("name") == "fleet.clock_sync":
+            a = e.get("args", {})
+            if "unix_ms" not in a:
+                fail(f"{pname}: fleet.clock_sync lacks unix_ms — "
+                     "re-record with Tracer.sync_instant")
+            return float(e["ts"]), float(a["unix_ms"]) * 1e3
+    fail(f"{pname}: no fleet.clock_sync event — was the process started "
+         "with --trace (router/replica) or a sync-stamped client Tracer?")
+
+
+def _load_fleet_docs(trace_dir: str):
+    """-> [(pname, new_pid, doc)] — router required, >=1 replica
+    required, client optional (reconcile degrades to a marker)."""
+    procs = []
+    cpath = os.path.join(trace_dir, "trace-client.json")
+    if os.path.exists(cpath):
+        try:
+            with open(cpath) as f:
+                procs.append(("client", 0, json.load(f)))
+        except (OSError, json.JSONDecodeError) as e:
+            fail(f"{cpath}: unreadable or truncated: {e}")
+    rpath = os.path.join(trace_dir, "trace-router.json")
+    if not os.path.exists(rpath):
+        fail(f"no trace-router.json in {trace_dir} (fleet mode needs "
+             "the router started with --trace)")
+    try:
+        with open(rpath) as f:
+            procs.append(("router", 1, json.load(f)))
+    except (OSError, json.JSONDecodeError) as e:
+        fail(f"{rpath}: unreadable or truncated: {e}")
+    reps = sorted(glob.glob(os.path.join(trace_dir,
+                                         "trace-replica*.json")))
+    if not reps:
+        fail(f"no trace-replica*.json in {trace_dir}")
+    for i, p in enumerate(reps):
+        name = re.sub(r"^trace-|\.json$", "", os.path.basename(p))
+        try:
+            with open(p) as f:
+                procs.append((name, 10 + i, json.load(f)))
+        except (OSError, json.JSONDecodeError) as e:
+            fail(f"{p}: unreadable or truncated: {e}")
+    return procs
+
+
+def _stitch_rids(events) -> dict:
+    """Per-rid causal table from the ALIGNED merged event stream."""
+    table: dict = {}
+
+    def ent(rid):
+        return table.setdefault(rid, {"phases": {}, "hops": []})
+
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        a = e.get("args", {})
+        name = e.get("name", "")
+        dur_ms = float(e.get("dur", 0.0)) / 1e3
+        if name == "client.request" and a.get("rid"):
+            ent(a["rid"])["client"] = {
+                "client_ms": round(dur_ms, 3),
+                "lag_ms": float(a.get("lag_ms", 0.0)),
+                "ok": bool(a.get("ok")),
+                "hops": int(a.get("hops", 1)),
+                **({"level": a["level"]} if "level" in a else {})}
+        elif name == "fleet.route" and a.get("rid"):
+            ent(a["rid"])["route"] = {
+                "outcome": a.get("outcome"),
+                **({"hops": int(a["hops"])} if "hops" in a else {})}
+        elif name == "fleet.hop" and a.get("rid"):
+            ent(a["rid"])["hops"].append(
+                {"replica": a.get("replica"),
+                 "outcome": a.get("outcome"),
+                 **({"attempt": int(a["attempt"])}
+                    if "attempt" in a else {}),
+                 **({"fanout": True} if a.get("fanout") else {})})
+        elif name.startswith("serve.phase.") and a.get("rid"):
+            ph = name[len("serve.phase."):]
+            d = ent(a["rid"])["phases"]
+            d[ph] = round(d.get(ph, 0.0) + dur_ms, 3)
+    return table
+
+
+def reconcile_fleet(table: dict, have_client: bool, tol_abs_ms: float,
+                    tol_rel: float, tol_clock_ms: float) -> dict:
+    """Phase-sum vs client-latency verdicts (see module docstring)."""
+    block = {"tol_abs_ms": tol_abs_ms, "tol_rel": tol_rel,
+             "tol_clock_ms": tol_clock_ms,
+             "phases_summed": list(FLEET_PHASES)}
+    if not have_client:
+        block["reconcile_unavailable"] = (
+            "no trace-client.json — replay with a sync-stamped client "
+            "Tracer (serve.client.replay_open_loop rid_prefix) to "
+            "reconcile phase sums against client latency")
+        return block
+    n = n_ok = 0
+    for rid in sorted(table):
+        ent = table[rid]
+        cl = ent.get("client")
+        if cl is None or not cl["ok"]:
+            continue          # rejected/unrouted: nothing to reconcile
+        n += 1
+        phases = ent["phases"]
+        if not all(p in phases for p in FLEET_PHASES):
+            ent["reconciled"] = False
+            ent["reconcile_gap"] = sorted(
+                p for p in FLEET_PHASES if p not in phases)
+            continue
+        phase_sum = sum(phases[p] for p in FLEET_PHASES)
+        residual = cl["client_ms"] - cl["lag_ms"] - phase_sum
+        ent["phase_sum_ms"] = round(phase_sum, 3)
+        ent["residual_ms"] = round(residual, 3)
+        ent["reconciled"] = (
+            -tol_clock_ms <= residual
+            <= tol_abs_ms + tol_rel * cl["client_ms"])
+        n_ok += bool(ent["reconciled"])
+    block.update(n_requests=n, n_reconciled=n_ok,
+                 fraction=round(n_ok / n, 4) if n else None)
+    return block
+
+
+def merge_fleet(trace_dir: str, tol_abs_ms: float = 75.0,
+                tol_rel: float = 0.25,
+                tol_clock_ms: float = 10.0) -> dict:
+    procs = _load_fleet_docs(trace_dir)
+    have_client = any(p[0] == "client" for p in procs)
+    ref_ts, ref_unix = fleet_sync(
+        next(d for n, _, d in procs if n == "router"), "router")
+    offsets = {}
+    events = []
+    span_counts = {}
+    for pname, pid, doc in procs:
+        ts_p, unix_p = fleet_sync(doc, pname)
+        off = ref_ts - ts_p + (unix_p - ref_unix)
+        offsets[pname] = off
+        n_spans = 0
+        for e in doc.get("traceEvents", []):
+            e = dict(e)
+            e["pid"] = pid
+            if "ts" in e:
+                e["ts"] = e["ts"] + off
+            events.append(e)
+            n_spans += e.get("ph") == "X"
+        span_counts[pname] = n_spans
+        if n_spans == 0 and pname != "client":
+            fail(f"{pname}: zero spans — tracing was installed but "
+                 "nothing recorded")
+    stamped = [e["ts"] for e in events if "ts" in e]
+    base = min(stamped) if stamped else 0.0
+    if base < 0:
+        for e in events:
+            if "ts" in e:
+                e["ts"] -= base
+    events.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
+                               e.get("ts", 0.0)))
+    table = _stitch_rids(events)
+    reconcile = reconcile_fleet(table, have_client, tol_abs_ms,
+                                tol_rel, tol_clock_ms)
+    frac = reconcile.get("fraction")
+    if frac is not None and frac < 1.0:
+        bad = [r for r in sorted(table)
+               if table[r].get("reconciled") is False]
+        print(f"merge_traces: WARNING: {len(bad)} request(s) fail the "
+              f"phase-sum reconcile (fraction {frac}): e.g. "
+              f"{ {r: table[r] for r in bad[:3]} }", file=sys.stderr)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "clock": {"source": "synced"},
+        "fleet": {
+            "processes": {n: {"pid": p, "spans": span_counts[n]}
+                          for n, p, _ in procs},
+            "clock_offsets_us": {n: round(o, 1)
+                                 for n, o in offsets.items()},
+            "requests": table,
+            "reconcile": reconcile,
+        },
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("trace_dir", help="directory holding trace-rank*.json")
@@ -349,15 +566,45 @@ def main(argv=None) -> int:
     ap.add_argument("--straggler-threshold", type=float, default=1.5,
                     help="flag ranks whose dist.solve time exceeds this "
                          "multiple of the across-rank median")
+    ap.add_argument("--fleet", action="store_true",
+                    help="merge a serving fleet's rid-tagged traces "
+                         "(trace-router.json + trace-replicaNN.json "
+                         "[+ trace-client.json]) instead of dist ranks")
+    ap.add_argument("--tol-abs-ms", type=float, default=75.0,
+                    help="fleet reconcile: absolute residual budget "
+                         "(connect/parse/relay overhead per request)")
+    ap.add_argument("--tol-rel", type=float, default=0.25,
+                    help="fleet reconcile: residual budget as a "
+                         "fraction of the client-measured latency")
+    ap.add_argument("--tol-clock-ms", type=float, default=10.0,
+                    help="fleet reconcile: allowed NEGATIVE residual "
+                         "(perf_counter rate noise across processes)")
     args = ap.parse_args(argv)
 
-    out_path = args.out or os.path.join(args.trace_dir, "trace-merged.json")
-    doc = merge(args.trace_dir, align=not args.no_align,
-                straggler_threshold=args.straggler_threshold)
+    if args.fleet:
+        out_path = args.out or os.path.join(args.trace_dir,
+                                            "trace-fleet-merged.json")
+        doc = merge_fleet(args.trace_dir, tol_abs_ms=args.tol_abs_ms,
+                          tol_rel=args.tol_rel,
+                          tol_clock_ms=args.tol_clock_ms)
+    else:
+        out_path = args.out or os.path.join(args.trace_dir,
+                                            "trace-merged.json")
+        doc = merge(args.trace_dir, align=not args.no_align,
+                    straggler_threshold=args.straggler_threshold)
     tmp = out_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f)
     os.replace(tmp, out_path)
+    if args.fleet:
+        fb = doc["fleet"]
+        rec = fb["reconcile"]
+        print(f"merge_traces: merged fleet "
+              f"{sorted(fb['processes'])} -> {out_path} "
+              f"({len(fb['requests'])} rid(s), offsets us: "
+              f"{fb['clock_offsets_us']}, reconciled: "
+              f"{rec.get('n_reconciled')}/{rec.get('n_requests')})")
+        return 0
     d = doc["dist"]
     print(f"merge_traces: merged {d['num_ranks']} ranks -> {out_path} "
           f"(spans per rank: {d['span_counts']}, offsets us: "
