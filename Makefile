@@ -8,7 +8,7 @@ CXXFLAGS ?= -O3 -Wall -shared -fPIC
 .PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
 	perf-gate check lint chaos-smoke telemetry-smoke serve-smoke \
 	race-smoke prune-smoke fleet-smoke fleet-chaos-smoke \
-	fleet-trace-smoke serve-bench fleet-bench clean
+	fleet-trace-smoke slo-smoke serve-bench fleet-bench clean
 
 all: native
 
@@ -19,7 +19,7 @@ native/_fastparse.so: native/fastparse.cpp
 
 test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint \
 	chaos-smoke telemetry-smoke serve-smoke race-smoke prune-smoke \
-	fleet-smoke fleet-chaos-smoke fleet-trace-smoke
+	fleet-smoke fleet-chaos-smoke fleet-trace-smoke slo-smoke
 	python -m pytest tests/ -q
 
 # Static analysis + runtime-sanitizer smoke (README "Static analysis &
@@ -287,6 +287,26 @@ fleet-trace-smoke:
 	JAX_PLATFORMS=cpu python tools/fleet_trace_smoke.py \
 	  --out outputs/fleet_trace \
 	  --record outputs/fleet_trace/TAILATTRIB.jsonl
+
+# Streaming SLO engine smoke (README "SLO objectives & predictive
+# autoscaling"): (1) a seeded breach on a deterministic clock fires
+# exactly one ok->pending->firing->ok alert cycle with the
+# FLIGHT_slo_breach_* dump + slo_* OpenMetrics families; (2) a
+# predictive-vs-reactive ramp A/B over a real supervised fleet — the
+# serve.solve delay fault makes replica capacity sleep-bound, the
+# reactive watermark arm rides one replica into a p99 breach (its
+# slo.alert stream validated by check_trace --fleet after the causal
+# merge) while the predictive arm follows the canary burn rate and
+# scales ahead of the hot level with zero customer-objective burn;
+# both arms byte-identical to the golden oracle, both ramp RunRecords
+# ledger-ingested as gated slo/<arm>/ series (SLO_r17.jsonl is the
+# committed round).
+slo-smoke:
+	mkdir -p outputs/slo
+	rm -f outputs/slo/SLO_SMOKE.jsonl
+	JAX_PLATFORMS=cpu python tools/slo_smoke.py \
+	  --out outputs/slo \
+	  --record outputs/slo/SLO_SMOKE.jsonl
 
 # Fleet SLO bench (not in `make test`; emits the FLEET_rNN ledger
 # rounds): 2 replicas (one mesh-resident) + router, the paced trace

@@ -140,6 +140,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--scale-low", type=float, default=0.25)
     p.add_argument("--poll-s", type=float, default=0.5,
                    help="supervisor watch interval")
+    # -- SLO objectives + predictive autoscaling -------------------------------
+    p.add_argument("--slo", action="append", default=None,
+                   metavar="SPEC",
+                   help="fleet-level SLO objective (repeatable), e.g. "
+                        "'fleet.request_latency_ms p99 < 50 over 1m'; "
+                        "availability specs sample good/total from the "
+                        "merged fleet scrape. Evaluated in the health "
+                        "loop (dmlp_tpu.obs.slo); transitions emit "
+                        "slo.alert events and the slo_* gauge family")
+    p.add_argument("--slo-trend", default=None, metavar="M,M",
+                   help="extra metrics to track Theil-Sen latency "
+                        "slopes for (slo.trend.* gauges)")
+    p.add_argument("--policy", choices=["reactive", "predictive"],
+                   default="reactive",
+                   help="supervised auto-scaling policy: 'reactive' = "
+                        "in-flight watermarks; 'predictive' = scale on "
+                        "the SLO burn rate / trend-projected crossing "
+                        "(falls back to reactive without signals)")
+    p.add_argument("--slo-objective", default=None, metavar="ID",
+                   help="objective id the predictive policy follows "
+                        "(default: the first --slo latency objective)")
+    p.add_argument("--lead-time-s", type=float, default=10.0,
+                   help="predictive policy scales up when the trend "
+                        "projects an SLO crossing within this horizon")
     args = p.parse_args(argv)
 
     # Idempotent backstop (the real install runs in fleet/__init__,
@@ -160,6 +184,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     else:
         replicas = _parse_replicas(args.replicas)
         scrape_ports = _parse_ports(args.scrape_ports, len(replicas))
+    if args.policy == "predictive" and not args.slo:
+        raise SystemExit("--policy predictive needs at least one --slo "
+                         "objective to read burn/trend signals from")
+    trend = ([m.strip() for m in args.slo_trend.split(",") if m.strip()]
+             if args.slo_trend else None)
     router = FleetRouter(replicas, scrape_ports=scrape_ports,
                          port=args.port,
                          health_interval_s=args.health_interval_s,
@@ -168,7 +197,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          revive_probes=args.revive_probes,
                          repair=args.repair == "on",
                          allow_empty=supervised,
-                         trace_path=args.trace)
+                         trace_path=args.trace,
+                         objectives=args.slo,
+                         slo_trend_metrics=trend)
     supervisor = None
     if supervised:
         from dmlp_tpu.fleet.autoscale import FleetSupervisor, ReplicaSpec
@@ -189,7 +220,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             unhealthy_deadline_s=args.unhealthy_deadline_s,
             scale_high=args.scale_high, scale_low=args.scale_low,
             reshard_threshold=(args.reshard_threshold
-                               if args.reshard_threshold > 0 else None))
+                               if args.reshard_threshold > 0 else None),
+            policy=args.policy, slo=router.slo,
+            slo_objective=args.slo_objective,
+            lead_time_s=args.lead_time_s)
         supervisor.start()
     try:
         signal.signal(signal.SIGTERM,

@@ -43,6 +43,7 @@ from outside the process.
 
 from __future__ import annotations
 
+import math
 import os
 import subprocess
 import threading
@@ -139,6 +140,47 @@ def target_replicas(window: List[float], current: int, minimum: int,
     return current
 
 
+def predictive_target_replicas(signals: Dict[str, Any], current: int,
+                               minimum: int, maximum: int, *,
+                               lead_time_s: float = 10.0,
+                               down_margin: float = 0.5) -> int:
+    """The PURE predictive policy over one SLO objective's live
+    signals (``obs.slo.SLOEvaluator.signals``): scale on the LEADING
+    indicators — error-budget burn and latency slope — not on queue
+    depth, which only moves once the SLO is already slipping.
+
+    Scale UP one step when the fast-window burn rate exceeds budget
+    (burn > 1: the objective is being violated right now) OR the
+    Theil–Sen latency slope projects the threshold crossing within
+    ``lead_time_s`` (``projected_s`` — the time a replica spawn +
+    warm takes is exactly the lead this buys). Scale DOWN one step
+    only when the picture is unambiguously calm: zero burn on BOTH
+    windows, non-positive slope, and the fast-window quantile under
+    ``down_margin`` of the threshold — the hysteresis gap between the
+    up and down conditions is what keeps flat load from oscillating
+    (a flat series trips neither side, so the decision is a fixed
+    point). One step at a time, clamped to [minimum, maximum]."""
+    burn_fast = float(signals.get("burn_fast", 0.0))
+    burn_slow = float(signals.get("burn_slow", 0.0))
+    slope = float(signals.get("slope_ms_per_s", 0.0))
+    projected = float(signals.get("projected_s", math.inf))
+    p_fast = signals.get("p_fast")
+    threshold = signals.get("threshold")
+    if math.isnan(slope):
+        slope = 0.0
+    if current < maximum and (burn_fast > 1.0
+                              or projected <= lead_time_s):
+        return current + 1
+    calm = burn_fast == 0.0 and burn_slow == 0.0 and slope <= 0.0
+    if calm and isinstance(p_fast, (int, float)) \
+            and isinstance(threshold, (int, float)) \
+            and not math.isnan(p_fast) \
+            and p_fast < down_margin * float(threshold) \
+            and current > minimum:
+        return current - 1
+    return current
+
+
 class FleetSupervisor:
     """Spawns, watches, scales, re-splits, and retires the managed
     replica fleet behind one :class:`FleetRouter`."""
@@ -154,7 +196,13 @@ class FleetSupervisor:
                  reshard_threshold: Optional[float] = None,
                  grow_factor: int = 2,
                  ready_timeout_s: float = 600.0,
-                 drain_timeout_s: float = 120.0):
+                 drain_timeout_s: float = 120.0,
+                 policy: str = "reactive",
+                 slo: Optional[Any] = None,
+                 slo_objective: Optional[str] = None,
+                 lead_time_s: float = 10.0):
+        if policy not in ("reactive", "predictive"):
+            raise ValueError(f"scaling policy {policy!r}")
         self.router = router
         self.spec = spec
         self.min_replicas = int(min_replicas)
@@ -170,6 +218,19 @@ class FleetSupervisor:
         self.grow_factor = max(int(grow_factor), 2)
         self.ready_timeout_s = ready_timeout_s
         self.drain_timeout_s = drain_timeout_s
+        #: "reactive" (watermarks over the in-flight window — the
+        #: fallback and A/B arm) or "predictive" (obs.slo burn + slope
+        #: signals; reverts to reactive while signals are absent)
+        self.policy = policy
+        self.slo = slo
+        if slo_objective is None and slo is not None:
+            # Default to the first declared latency objective — the
+            # burn/slope signal set the predictive policy consumes.
+            slo_objective = next(
+                (o.name for o in getattr(slo, "objectives", [])
+                 if getattr(o, "kind", "") == "latency"), None)
+        self.slo_objective = slo_objective
+        self.lead_time_s = float(lead_time_s)
         self._lock = threading.Lock()     # guards managed/retired lists
         self.managed: List[ManagedReplica] = []
         self.retired: List[Dict[str, Any]] = []
@@ -434,6 +495,9 @@ class FleetSupervisor:
         return sum(r.load() for r in reps) / len(reps)
 
     def _check_scaling(self) -> None:
+        if self.policy == "predictive" \
+                and self._check_scaling_predictive():
+            return
         load = (self.load_fn or self.offered_load)()
         self._load_window.append(float(load))
         if len(self._load_window) < self.scale_window:
@@ -447,11 +511,44 @@ class FleetSupervisor:
             target)
         if target == current:
             return
-        reg = telemetry.registry()
         self._load_window.clear()     # re-observe after acting
+        self._act_on_target(current, target, "reactive")
+
+    def _check_scaling_predictive(self) -> bool:
+        """The predictive arm: one scaling decision from the SLO
+        evaluator's live burn-rate + slope signals. Returns False
+        (-> reactive fallback) while the evaluator has no usable
+        signal yet — an SLO engine that has not evaluated anything
+        must not freeze scaling entirely."""
+        if self.slo is None or not self.slo_objective:
+            return False
+        try:
+            sig = self.slo.signals(self.slo_objective)
+        except KeyError:
+            return False
+        if "burn_fast" not in sig:
+            return False               # no evaluation tick yet
+        current = len([m for m in self._managed_list()
+                       if not m.retiring])
+        target = predictive_target_replicas(
+            sig, current, self.min_replicas, self.max_replicas,
+            lead_time_s=self.lead_time_s)
+        telemetry.registry().gauge("fleet.scale.target_replicas").set(
+            target)
+        if target != current:
+            self._act_on_target(current, target, "predictive")
+        return True
+
+    def _act_on_target(self, current: int, target: int,
+                       policy: str) -> None:
+        """Shared one-step actuation for both policies: spawn or
+        retire, with the policy stamped on the trace event so the A/B
+        arms are attributable in the merged fleet trace."""
+        reg = telemetry.registry()
         if target > current:
-            reg.counter("fleet.scale.up").inc()
-            obs_instant("fleet.scale.up", replicas=target)
+            reg.counter("fleet.scale.up").inc(label=policy)
+            obs_instant("fleet.scale.up", replicas=target,
+                        policy=policy)
             try:
                 self.spawn_one()
             except Exception as e:  # check: no-retry — scale-up is
@@ -462,6 +559,7 @@ class FleetSupervisor:
             victim = next((m for m in reversed(self._managed_list())
                            if not m.retiring), None)
             if victim is not None:
-                reg.counter("fleet.scale.down").inc()
-                obs_instant("fleet.scale.down", replica=victim.name)
+                reg.counter("fleet.scale.down").inc(label=policy)
+                obs_instant("fleet.scale.down", replica=victim.name,
+                            policy=policy)
                 self.retire(victim, drain=True, reason="scale_down")

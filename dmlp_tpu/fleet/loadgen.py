@@ -17,7 +17,7 @@ ledger (obs.ledger qualifies A/B comparisons on raw trial lists).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -129,3 +129,160 @@ def run_levels(port: int, header: Dict[str, Any],
                     "requests_per_rep": len(requests), "reps": reps},
             metrics=metrics, device=device))
     return out
+
+
+# -- the SLO ramp (predictive-vs-reactive A/B) --------------------------------
+
+def _fleet_slo_stats(port: int) -> Dict[str, Any]:
+    """One ``stats`` op against the front-end: the router's SLO
+    snapshot + replica count, shape-normalized for the ramp record."""
+    st = sc.ServeClient(port).stats().get("stats", {})
+    out: Dict[str, Any] = {
+        "replicas": st.get("healthy_replicas"),
+        "objectives": {},
+    }
+    for name, o in (st.get("slo") or {}).get("objectives", {}).items():
+        out["objectives"][name] = {
+            "state": o.get("state"), "cycles": o.get("cycles", 0),
+            "burn_fast": o.get("burn_fast", 0.0),
+            "burn_slow": o.get("burn_slow", 0.0)}
+    return out
+
+
+def run_ramp(port: int, header: Dict[str, Any],
+             requests: List[Dict[str, Any]],
+             speeds: Sequence[float], *,
+             settle_s: float = 0.0,
+             stats_fn: Optional[Callable[[], Dict[str, Any]]] = None
+             ) -> List[Dict[str, Any]]:
+    """The escalating-load ramp the SLO engine is judged on: each
+    speed level runs one open-loop replay (ASCENDING — the fleet sees
+    load rise, which is what a leading autoscale signal must get ahead
+    of), then the front-end's stats op is sampled for the SLO
+    snapshot. ``settle_s`` idles between levels so a predictive
+    scale-up spawned mid-level can become ready before the next step
+    (the lead time the policy is buying). Returns one step dict per
+    level: the replay metrics plus ``slo`` (per-objective state /
+    burn / completed alert cycles) and the live replica count."""
+    steps: List[Dict[str, Any]] = []
+    for speed in list(speeds):
+        metrics = run_level(port, header, requests, speed, reps=1)
+        oq = offered_qps(requests, speed)
+        if oq is not None:
+            metrics["offered_qps"] = oq
+        step: Dict[str, Any] = {"speed": speed,
+                                "level": level_tag(speed),
+                                "metrics": metrics}
+        try:
+            step["slo"] = (stats_fn or
+                           (lambda: _fleet_slo_stats(port)))()
+        except Exception as e:  # check: no-retry — a stats blip must
+            # not abort the ramp mid-experiment
+            step["slo"] = {"error": f"{type(e).__name__}: {e}"}
+        steps.append(step)
+        if settle_s > 0:
+            import time
+            time.sleep(settle_s)
+    return steps
+
+
+def ramp_record(arm: str, objective: str,
+                steps: List[Dict[str, Any]], *,
+                replicas: int = 1, trace: str = "",
+                tool: str = "dmlp_tpu.fleet.loadgen") -> RunRecord:
+    """One kind="slo" RunRecord summarizing a ramp arm (ledger series
+    ``slo/<arm>/<metric>``, gated by ``tools/perf_gate.py``). The A/B
+    contract the smoke asserts lives in these metrics: the predictive
+    arm's ``breach_cycles`` stays 0 (and ``max_burn_fast`` <= 1)
+    at ramp levels where the reactive arm's breach fires."""
+    peak = steps[-1]["metrics"] if steps else {}
+    max_burn_fast = 0.0
+    max_burn_slow = 0.0
+    breach_cycles = 0
+    worst = 0                     # 0 ok / 1 pending / 2 firing
+    replicas_final = replicas
+    for step in steps:
+        slo = step.get("slo") or {}
+        if slo.get("replicas"):
+            replicas_final = int(slo["replicas"])
+        # Burn maxima are scoped to the DECLARED objective: a canary
+        # objective the predictive policy follows is EXPECTED to burn
+        # (that is the lead it buys) and must not pollute the gated
+        # customer-objective series.
+        target = (slo.get("objectives") or {}).get(objective, {})
+        max_burn_fast = max(max_burn_fast,
+                            float(target.get("burn_fast", 0.0)))
+        max_burn_slow = max(max_burn_slow,
+                            float(target.get("burn_slow", 0.0)))
+        breach_cycles = max(breach_cycles,
+                            int(target.get("cycles", 0)))
+        state = str(target.get("state", "ok"))
+        worst = max(worst, {"ok": 0, "pending": 1,
+                            "firing": 2}.get(state, 0))
+        if state == "firing":
+            breach_cycles = max(breach_cycles, 1)
+    metrics: Dict[str, Any] = {
+        "levels": len(steps),
+        "breach_cycles": breach_cycles,
+        "worst_state_level": worst,
+        "max_burn_fast": round(max_burn_fast, 4),
+        "max_burn_slow": round(max_burn_slow, 4),
+        "replicas_final": replicas_final,
+    }
+    for key in ("p99_ms", "p95_ms", "p50_ms", "offered_qps",
+                "achieved_qps"):
+        if key in peak:
+            metrics[f"peak_{key}"] = peak[key]
+    errors = sum(int(s["metrics"].get("errors", 0)) for s in steps)
+    rejected = sum(int(s["metrics"].get("rejected", 0)) for s in steps)
+    metrics["errors"] = errors
+    metrics["rejected"] = rejected
+    return RunRecord(
+        kind="slo", tool=tool,
+        config={"arm": arm, "objective": objective, "mode": "ramp",
+                "levels": [s["level"] for s in steps],
+                "replicas": replicas, "trace": trace},
+        metrics=metrics, device=current_device())
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python -m dmlp_tpu.fleet.loadgen`` — drive one arm of the
+    ramp against a running front-end and append its kind="slo"
+    RunRecord (``slo/<arm>/...`` ledger series)."""
+    import argparse
+    import json
+    import sys
+    p = argparse.ArgumentParser(prog="dmlp_tpu.fleet.loadgen")
+    p.add_argument("--port", type=int, required=True,
+                   help="front-end (or daemon) port to drive")
+    p.add_argument("--trace", required=True,
+                   help="paced replay trace (serve.client.load_trace)")
+    p.add_argument("--ramp", required=True, metavar="S,S,S",
+                   help="ascending speed multipliers, e.g. 1,2,4")
+    p.add_argument("--arm", required=True,
+                   help="A/B arm tag recorded in the slo/ series "
+                        "(e.g. predictive, reactive)")
+    p.add_argument("--objective", required=True, metavar="ID",
+                   help="objective id the ramp verdict keys on")
+    p.add_argument("--record", default=None, metavar="FILE",
+                   help="append the arm's kind=slo RunRecord here")
+    p.add_argument("--settle-s", type=float, default=0.0)
+    p.add_argument("--replicas", type=int, default=1)
+    args = p.parse_args(argv)
+    header, reqs = sc.load_trace(args.trace)
+    speeds = [float(s) for s in args.ramp.split(",") if s.strip()]
+    steps = run_ramp(args.port, header, reqs, speeds,
+                     settle_s=args.settle_s)
+    rec = ramp_record(args.arm, args.objective, steps,
+                      replicas=args.replicas, trace=args.trace)
+    if args.record:
+        rec.append_jsonl(args.record)
+    json.dump({"arm": args.arm, "metrics": rec.metrics},
+              sys.stdout, indent=1, sort_keys=True)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -278,7 +278,9 @@ class FleetRouter:
                  revive_probes: int = 1, repair: bool = True,
                  divergence_probes: int = 2,
                  allow_empty: bool = False,
-                 trace_path: Optional[str] = None):
+                 trace_path: Optional[str] = None,
+                 objectives: Optional[List[Any]] = None,
+                 slo_trend_metrics: Optional[List[str]] = None):
         scrape_ports = scrape_ports or [None] * len(replicas)
         if len(scrape_ports) != len(replicas):
             raise ValueError("one scrape port per replica (or none)")
@@ -335,6 +337,25 @@ class FleetRouter:
         if trace_path:
             self._tracer = obs_trace.install(obs_trace.Tracer())
             self._tracer.sync_instant("fleet.clock_sync")
+        # Fleet-level SLO objectives (obs.slo): latency objectives read
+        # the router's own end-to-end fleet.request_latency_ms windowed
+        # histogram; availability objectives sample good/total counters
+        # from the MERGED replica scrape (the fleet served what its
+        # replicas served). Evaluated on the health thread's cadence.
+        self.slo = None
+        if objectives:
+            from dmlp_tpu.obs import slo as obs_slo
+            objs: List[Any] = []
+            for spec in objectives:
+                obj = obs_slo.parse_objective(spec) \
+                    if isinstance(spec, str) else spec
+                if obj.kind == "availability" \
+                        and obj.sample_fn is None:
+                    obj.sample_fn = self._scrape_availability(obj)
+                objs.append(obj)
+            self.slo = obs_slo.SLOEvaluator(
+                objs, telemetry.REGISTRY,
+                trend_metrics=list(slo_trend_metrics or []))
 
     # -- the dynamic replica table ---------------------------------------------
 
@@ -568,9 +589,26 @@ class FleetRouter:
                     "fleet.consistency.unrepairable", replica=name,
                     reason=res.get("reason", ""))
 
+    def _scrape_availability(self, obj):
+        """Cumulative (good, total) for one availability objective,
+        summed across every replica via the merged fleet scrape."""
+        from dmlp_tpu.fleet import scrape as fscrape
+
+        def sample():
+            parsed = fscrape.parse_exposition(self.fleet_metrics_text())
+            return (fscrape.counter_total(parsed, obj.good),
+                    fscrape.counter_total(parsed, obj.total))
+
+        return sample
+
     def _health_loop(self, stop: threading.Event) -> None:
         while not stop.wait(timeout=self.health_interval_s):
             self._probe_all()
+            if self.slo is not None:
+                try:
+                    self.slo.tick()
+                except Exception:  # check: no-retry — a failing SLO
+                    pass           # tick must not stop health probing
 
     # -- routing ---------------------------------------------------------------
 
@@ -787,6 +825,11 @@ class FleetRouter:
         if self.supervisor is not None:
             try:
                 out["supervisor"] = self.supervisor.snapshot()
+            except Exception:  # check: no-retry — stats never fail
+                pass
+        if self.slo is not None:
+            try:
+                out["slo"] = self.slo.snapshot()
             except Exception:  # check: no-retry — stats never fail
                 pass
         h = reg.get("fleet.request_latency_ms")

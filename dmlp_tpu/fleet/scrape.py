@@ -196,6 +196,14 @@ def merge_expositions(texts: List[str],
     return "\n".join(lines) + "\n", problems
 
 
+def counter_total(parsed: ParsedExposition, name: str) -> float:
+    """Sum of one counter family's samples across its labels — the
+    merged-scrape read path the router's fleet-level availability
+    objective samples from (``obs.slo``). Accepts the registry's
+    dotted name or the OpenMetrics underscore name."""
+    return sum(parsed.samples.get(name.replace(".", "_"), {}).values())
+
+
 def scrape_url(url: str, timeout_s: float = 10.0) -> str:
     with urllib.request.urlopen(url, timeout=timeout_s) as r:
         return r.read().decode()

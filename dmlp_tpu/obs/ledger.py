@@ -82,7 +82,8 @@ ARTIFACT_PATTERNS = (
 #: series units whose LOWER values are better (everything timing);
 #: key-name suffix heuristics — see _better_direction
 _LOWER_BETTER_HINTS = ("_ms", "_s", "_us", "_sec", "ms", "elapsed",
-                      "time", "wall", "overhead_pct", "peak_hbm")
+                      "time", "wall", "overhead_pct", "peak_hbm",
+                      "breach", "burn")
 # NOTE: no bare "pairs" hint — it would substring-match "repairs"
 # (a repair COUNT, where more is worse) and invert the gate's verdict;
 # qd_pairs_per_sec is already covered by "per_sec".
@@ -299,6 +300,18 @@ def _runrecord_series_name(rec: RunRecord, key: str) -> str:
             else None
         tag = f"/{lvl}" if lvl else ""
         return f"fleet{tag}/phase/{key}"
+    if rec.kind == "slo":
+        # SLO ramp A/B records (fleet.loadgen.ramp_record): one
+        # ``slo/<arm>/<metric>`` series per autoscale arm (config
+        # "arm" = "predictive" / "reactive"), so the breach count and
+        # peak-p99 of each arm gate independently — the predictive
+        # arm's zero-breach contract can't hide behind the reactive
+        # arm's expected firing.
+        arm = rec.config.get("arm") if isinstance(rec.config, dict) \
+            else None
+        tag = (f"/{arm}" if arm
+               else (f"/config{cid}" if cid is not None else ""))
+        return f"slo{tag}/{key}"
     if rec.kind == "fleet":
         # Open-loop SLO records (fleet.loadgen) + the router snapshot:
         # one ``fleet/<level>/<metric>`` series per offered-load level

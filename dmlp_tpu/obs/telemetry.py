@@ -161,13 +161,47 @@ class Gauge:
             return out
 
 
+#: default windowed-quantile sub-window width (seconds) — the sliding
+#: window's time resolution; enable_windows() overrides per histogram
+WINDOW_SUB_S = 2.5
+#: default longest sliding window served (seconds)
+WINDOW_MAX_S = 300.0
+
+
+class _WindowFrame:
+    """One sub-window of a windowed histogram: a SPARSE bucket->count
+    map plus exact count/sum/min/max, stamped with its grid-aligned
+    start time. Sparse because a sub-window typically touches a few
+    buckets out of 201."""
+
+    __slots__ = ("start", "counts", "count", "sum", "mn", "mx")
+
+    def __init__(self, start: float):
+        self.start = start
+        self.counts: Dict[int, int] = {}
+        self.count = 0
+        self.sum = 0.0
+        self.mn = math.inf
+        self.mx = -math.inf
+
+
 class Histogram:
     """Streaming histogram over fixed log-spaced buckets.
 
     O(1) memory, bounded-error quantiles (module docstring): values at
     or below :data:`HIST_LO` land in bucket 0, values beyond the last
     bound in the overflow bucket; exact min/max/sum/count ride along so
-    the clamp never hides the extremes."""
+    the clamp never hides the extremes.
+
+    **Sliding windows** (opt-in via :meth:`enable_windows`): a rotating
+    ring of sub-window bucket snapshots (:class:`_WindowFrame`, width
+    ``sub_s``) so p50/p95/p99 are computable over the trailing 10 s /
+    1 m / 5 m instead of cumulative-since-start. A window quantile
+    carries the SAME :data:`HIST_QUANTILE_REL_ERROR` bound as the
+    cumulative one (the bucket grid is shared; min/max are exact per
+    frame), plus a time-resolution slack of at most one sub-window of
+    extra trailing data. The streaming SLO engine (obs.slo) is the
+    consumer."""
 
     kind = "histogram"
 
@@ -183,6 +217,80 @@ class Histogram:
         # carrying observation per bucket, so a p99 bucket links back
         # to one reconstructable request (rid) in the merged trace.
         self._exemplars: Dict[int, Tuple[str, float]] = {}
+        # sliding-window ring (None until enable_windows): guarded by
+        # _lock like every other field — observe() appends into the
+        # open frame, readers merge the frames inside the window.
+        self._frames: Optional[deque] = None
+        self._sub_s = WINDOW_SUB_S
+        self._time = time.monotonic
+
+    def enable_windows(self, max_window_s: float = WINDOW_MAX_S,
+                       sub_s: float = WINDOW_SUB_S,
+                       time_fn=None) -> None:
+        """Turn on the sliding-window ring (idempotent; the FIRST
+        enablement pins the geometry). ``time_fn`` injects a clock for
+        deterministic rotation-boundary tests; production uses
+        ``time.monotonic``."""
+        if sub_s <= 0 or max_window_s < sub_s:
+            raise ValueError(
+                f"window geometry max={max_window_s} sub={sub_s} "
+                "needs 0 < sub_s <= max_window_s")
+        with self._lock:
+            if self._frames is not None:
+                return
+            if time_fn is not None:
+                self._time = time_fn
+            self._sub_s = float(sub_s)
+            cap = int(math.ceil(max_window_s / self._sub_s)) + 1
+            self._frames = deque(maxlen=max(cap, 2))
+            self._frames.append(_WindowFrame(self._time()))
+
+    @property
+    def windowed(self) -> bool:
+        with self._lock:
+            return self._frames is not None
+
+    def _rotate_locked(self) -> float:
+        """Close the open frame if its sub-window elapsed; returns
+        ``now``. The new frame's start is GRID-ALIGNED to the first
+        frame's schedule, so an idle gap yields a fresh frame at the
+        right phase instead of one frame stretched across the gap
+        (stale samples would then never age out)."""
+        # check: allow-concurrency=R702 — every caller holds self._lock
+        # (the ``_locked`` suffix is the contract); _time/_frames/_sub_s
+        # are only ever mutated under that same lock.
+        now, frames, sub_s = self._time(), self._frames, self._sub_s
+        last = frames[-1]
+        if now - last.start >= sub_s:
+            steps = int((now - last.start) // sub_s)
+            frames.append(_WindowFrame(last.start + steps * sub_s))
+        return now
+
+    def _window_merge_locked(self, window_s: float
+                             ) -> Tuple[List[int], int, float, float,
+                                        float]:
+        """Merge every frame overlapping the trailing ``window_s``
+        into one (counts, count, sum, min, max) state. Caller holds
+        the lock."""
+        # check: allow-concurrency=R702 — caller holds self._lock (the
+        # ``_locked`` suffix is the contract); _frames/_sub_s are only
+        # ever mutated under that same lock.
+        frames, sub_s = self._frames, self._sub_s
+        now = self._rotate_locked()
+        cutoff = now - float(window_s)
+        counts = [0] * (_NBUCKETS + 1)
+        count, total = 0, 0.0
+        mn, mx = math.inf, -math.inf
+        for fr in frames:
+            if fr.start + sub_s <= cutoff:
+                continue                     # fully aged out
+            for i, c in fr.counts.items():
+                counts[i] += c
+            count += fr.count
+            total += fr.sum
+            mn = min(mn, fr.mn)
+            mx = max(mx, fr.mx)
+        return counts, count, total, mn, mx
 
     @staticmethod
     def bucket_index(v: float) -> int:
@@ -209,6 +317,14 @@ class Histogram:
             self._max = max(self._max, v)
             if exemplar is not None:
                 self._exemplars[i] = (str(exemplar), v)
+            if self._frames is not None:
+                self._rotate_locked()
+                fr = self._frames[-1]
+                fr.counts[i] = fr.counts.get(i, 0) + 1
+                fr.count += 1
+                fr.sum += v
+                fr.mn = min(fr.mn, v)
+                fr.mx = max(fr.mx, v)
 
     def exemplars(self) -> Dict[int, Tuple[str, float]]:
         """bucket index -> (exemplar id, observed value) snapshot."""
@@ -260,6 +376,65 @@ class Histogram:
             counts = list(self._counts)
             count, mn, mx = self._count, self._min, self._max
         return self._quantile_from(counts, count, mn, mx, q)
+
+    def window_quantile(self, window_s: float, q: float) -> float:
+        """Bounded-error quantile over the trailing ``window_s``
+        seconds (same :data:`HIST_QUANTILE_REL_ERROR` bound as
+        :meth:`quantile`). NaN when the window holds no samples.
+        Raises if :meth:`enable_windows` was never called."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        with self._lock:
+            if self._frames is None:
+                raise ValueError(
+                    f"histogram {self.name!r} has no window ring "
+                    "(call enable_windows first)")
+            counts, count, _, mn, mx = self._window_merge_locked(
+                window_s)
+        return self._quantile_from(counts, count, mn, mx, q)
+
+    def window_snapshot(self, window_s: float) -> Dict[str, Any]:
+        """count/sum/min/max/p50/p95/p99 over the trailing
+        ``window_s`` seconds from ONE consistent merged state (same
+        one-lock-acquisition discipline as :meth:`snapshot`)."""
+        with self._lock:
+            if self._frames is None:
+                raise ValueError(
+                    f"histogram {self.name!r} has no window ring "
+                    "(call enable_windows first)")
+            counts, count, total, mn, mx = self._window_merge_locked(
+                window_s)
+        out: Dict[str, Any] = {"window_s": float(window_s),
+                               "count": count, "sum": round(total, 6)}
+        if count:
+            out.update(
+                min=mn, max=mx,
+                p50=self._quantile_from(counts, count, mn, mx, 0.5),
+                p95=self._quantile_from(counts, count, mn, mx, 0.95),
+                p99=self._quantile_from(counts, count, mn, mx, 0.99))
+        return out
+
+    def window_above(self, window_s: float,
+                     threshold: float) -> Tuple[int, int]:
+        """(bad, total) sample counts over the trailing ``window_s``:
+        ``bad`` counts samples above ``threshold`` at BUCKET
+        resolution — samples sharing the threshold's own bucket count
+        as good, so the split carries the same relative-error bound as
+        the quantiles. The burn-rate evaluator's primitive."""
+        with self._lock:
+            if self._frames is None:
+                raise ValueError(
+                    f"histogram {self.name!r} has no window ring "
+                    "(call enable_windows first)")
+            counts, count, _, mn, mx = self._window_merge_locked(
+                window_s)
+        if count == 0:
+            return 0, 0
+        if mx <= threshold:          # exact max rules the window good
+            return 0, count
+        ti = self.bucket_index(threshold)
+        bad = sum(counts[ti + 1:])
+        return bad, count
 
     def bucket_counts(self) -> List[Tuple[float, int]]:
         """(upper bound, cumulative count) pairs, ending with +Inf."""
@@ -720,10 +895,30 @@ class Sampler:
             t = self._thread
         return t is not None and t.is_alive()
 
+    @staticmethod
+    def _next_deadline(prev_deadline: float, now: float,
+                       interval: float) -> Tuple[float, float]:
+        """Advance the tick deadline on a MONOTONIC grid: the next
+        deadline is ``prev + k*interval`` for the smallest k landing
+        in the future, so the effective period is ``interval`` — not
+        ``interval + work time`` (the drift the old sleep-after-work
+        loop accumulated: a 0.25 s sampler doing 50 ms of polling ran
+        at 0.3 s and every derived rate read ~17% low). Overruns skip
+        the missed grid points (no catch-up burst) but keep the
+        phase. Returns (new deadline, seconds to wait)."""
+        nxt = prev_deadline + interval
+        if nxt <= now:
+            missed = math.floor((now - prev_deadline) / interval)
+            nxt = prev_deadline + (missed + 1) * interval
+        return nxt, max(nxt - now, 0.0)
+
     def _loop(self, stop: threading.Event) -> None:
+        deadline = time.monotonic()
         while not stop.is_set():
             self.sample_now()
-            stop.wait(self.interval_s)
+            deadline, delay = self._next_deadline(
+                deadline, time.monotonic(), self.interval_s)
+            stop.wait(delay)
 
     def sample_now(self) -> None:
         """One synchronous sampling tick — also exposed so the engines

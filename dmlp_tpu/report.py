@@ -103,6 +103,24 @@ def _memory_rows(ledger: Dict[str, Any]) -> List[str]:
     return rows
 
 
+def _slo_rows(ledger: Dict[str, Any]) -> List[str]:
+    """SLO section: the ``slo/<arm>/...`` ramp A/B series
+    (fleet.loadgen.ramp_record) — per-arm breach cycles, worst burn
+    rates, and peak-level latency, so the predictive-vs-reactive
+    verdict reads off the report round-over-round."""
+    rows = []
+    for name, pts in sorted(ledger.get("series", {}).items()):
+        if not name.startswith("slo/"):
+            continue
+        for p in pts:
+            rows.append(
+                f"- `{name}` r{p.get('round', '?')}: {_fmt(p['value'])}"
+                + (f" ({p['device']})"
+                   if p.get("device") not in (None, "unspecified")
+                   else ""))
+    return rows
+
+
 def render_markdown(ledger: Dict[str, Any]) -> str:
     cov = ledger["coverage"]
     lines = ["# dmlp_tpu perf ledger", ""]
@@ -143,6 +161,16 @@ def render_markdown(ledger: Dict[str, Any]) -> str:
     if roof:
         lines += ["", "## Roofline & observability-cost records", ""]
         lines += roof
+
+    slo = _slo_rows(ledger)
+    if slo:
+        lines += ["", "## SLO", "",
+                  "Ramp A/B (fleet.loadgen --ramp): per-arm breach "
+                  "cycles and burn rates under escalating offered "
+                  "load — the predictive arm's contract is zero "
+                  "breach cycles at levels where the reactive arm "
+                  "fires.", ""]
+        lines += slo
 
     mem = _memory_rows(ledger)
     if mem:

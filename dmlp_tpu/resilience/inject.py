@@ -81,6 +81,14 @@ SITES: Dict[str, str] = {
                    "fault here is the injected memory squeeze — the "
                    "controller must SHED the request before any "
                    "allocation, visibly, with no ladder degradation)",
+    "serve.solve": "serving-daemon micro-batch solve execution "
+                   "(serve.batching.MicroBatcher._execute_batch, on "
+                   "the single consumer thread; a delay fault is the "
+                   "injected straggler solve — per-replica service "
+                   "time inflates while the CPU idles, the lever "
+                   "tools/slo_smoke.py uses to make replica capacity "
+                   "sleep-bound on a CPU-only container; a transient "
+                   "fault fails the whole batch visibly)",
     "serve.ingest": "serving-daemon ingest execution "
                     "(serve.batching.MicroBatcher._execute_ingest; a "
                     "transient fault here is the injected DROPPED "

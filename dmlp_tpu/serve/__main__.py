@@ -103,6 +103,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "spans here on drain (rid-tagged; merge the "
                         "fleet's files with tools/merge_traces.py "
                         "--fleet)")
+    p.add_argument("--slo", action="append", default=None,
+                   metavar="SPEC",
+                   help="declare an SLO objective (repeatable), e.g. "
+                        "'serve.request_latency_ms p99 < 50 over 1m' "
+                        "or 'serve.requests_completed/serve.admitted "
+                        "availability > 0.999 over 5m'; evaluated "
+                        "continuously (dmlp_tpu.obs.slo) — transitions "
+                        "emit slo.alert trace/flight events and the "
+                        "slo_* OpenMetrics family")
     p.add_argument("--ready-file", metavar="PATH", default=None)
     p.add_argument("--faults", metavar="FILE", default=None,
                    help="fault-injection schedule "
@@ -158,7 +167,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         telemetry_port=args.telemetry_port, record_path=args.record,
         snapshot_every_s=args.snapshot_every_s, warm_buckets=warm,
         mesh_shape=mesh_shape, mesh_merge=args.mesh_merge,
-        trace_path=args.trace)
+        trace_path=args.trace, objectives=args.slo)
     try:
         daemon.start()
         sys.stderr.write(f"dmlp_tpu.serve: ready port={daemon.port} "

@@ -327,6 +327,14 @@ class MicroBatcher:
         rids = ",".join(r.rid for r in batch if r.rid) if tracing else ""
         t0 = time.perf_counter()
         try:
+            # The chaos harness's straggler-solve site: a delay fault
+            # here slows THIS replica's serialized batch execution (the
+            # single consumer sleeps while the core stays idle), which
+            # is how tools/slo_smoke.py emulates accelerator-bound
+            # service times on a CPU-only container; a transient fault
+            # fails the whole batch visibly (serve.batch_errors).
+            rs_inject.fire("serve.solve", requests=len(batch),
+                           queries=total)
             with obs_span("serve.micro_batch", requests=len(batch),
                           queries=total, qpad=qpad,
                           **({"rids": rids} if rids else {})):

@@ -130,7 +130,8 @@ class ServeDaemon:
                  warm_buckets: Optional[List[Tuple[int, int]]] = None,
                  mesh_shape: Optional[Tuple[int, int]] = None,
                  mesh_merge: str = "allgather",
-                 trace_path: Optional[str] = None):
+                 trace_path: Optional[str] = None,
+                 objectives: Optional[List[Any]] = None):
         self.corpus = corpus
         self.record_path = record_path
         self.snapshot_every_s = snapshot_every_s
@@ -178,6 +179,18 @@ class ServeDaemon:
         self.batcher = MicroBatcher(self.engine, self.admission,
                                     max_batch_queries=max_batch_queries,
                                     tick_s=tick_s)
+        # SLO objective plumb-through: string specs ("serve.request_
+        # latency_ms p99 < 50 over 1m") or Objective instances. The
+        # evaluator binds windowed rings onto the registry histograms;
+        # it is constructed AFTER the serve.* reset above so the bound
+        # histogram is the one this lifetime observes into. Ticked by
+        # run_until_drained(); in-process embeddings tick it directly.
+        self.slo = None
+        if objectives:
+            from dmlp_tpu.obs import slo as obs_slo
+            objs = [obs_slo.parse_objective(o) if isinstance(o, str)
+                    else o for o in objectives]
+            self.slo = obs_slo.SLOEvaluator(objs, telemetry.registry())
         self._warm = (warm_buckets if warm_buckets is not None
                       else default_warm_buckets(corpus))
         self._drain_event = threading.Event()
@@ -305,6 +318,11 @@ class ServeDaemon:
                 "p99": round(h.quantile(0.99), 3),
                 "count": h.count,
             }
+        if self.slo is not None:
+            try:
+                out["slo"] = self.slo.snapshot()
+            except Exception:  # check: no-retry
+                pass
         return out
 
     # -- ledger records --------------------------------------------------------
@@ -367,6 +385,11 @@ class ServeDaemon:
         next_snap = (time.monotonic() + self.snapshot_every_s
                      if self.snapshot_every_s else None)
         while not self._drain_event.wait(timeout=0.2):
+            if self.slo is not None:
+                try:
+                    self.slo.tick()
+                except Exception:  # check: no-retry — SLO evaluation
+                    pass           # never takes down the serve loop
             if next_snap is not None and time.monotonic() >= next_snap:
                 self._append_record()
                 next_snap = time.monotonic() + self.snapshot_every_s

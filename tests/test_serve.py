@@ -377,6 +377,68 @@ def test_batcher_drain_finishes_queued_work():
     assert all(r.done.is_set() and r.error is None for r in reqs)
 
 
+def test_batcher_serve_solve_injection_site():
+    """The ``serve.solve`` straggler site: a delay fault slows the
+    consumer WITHOUT changing answers (the slo_smoke capacity lever);
+    a transient fault fails the whole batch visibly and the batcher
+    survives it."""
+    import time as _time
+
+    from dmlp_tpu.resilience import inject
+    from dmlp_tpu.resilience.inject import FaultSchedule
+
+    corpus = make_corpus()
+    eng = ResidentEngine(corpus, EngineConfig())
+    b = MicroBatcher(eng, AdmissionController(eng), tick_s=0.0)
+    rng = np.random.default_rng(7)
+
+    def mkreq(i: int) -> Request:
+        return Request(kind="query", req_id=f"inj{i}",
+                       query_attrs=rng.uniform(-10, 10, (2, 5)),
+                       ks=np.full(2, 3, np.int32))
+
+    b.start()
+    try:
+        inject.install(FaultSchedule.from_dict(
+            {"schema": 1, "seed": 1, "faults": [
+                {"site": "serve.solve", "kind": "delay", "ms": 120,
+                 "times": 10, "prob": 1.0}]}))
+        r = mkreq(0)
+        t0 = _time.perf_counter()
+        assert b.submit(r)["verdict"] == "accept"
+        assert r.done.wait(timeout=120)
+        assert r.error is None
+        assert _time.perf_counter() - t0 >= 0.12, \
+            "delay fault did not slow the batch"
+        assert format_results(r.results) == solo_and_golden(
+            corpus, r.query_attrs, r.ks), \
+            "delay fault perturbed the answers"
+
+        inject.install(FaultSchedule.from_dict(
+            {"schema": 1, "seed": 1, "faults": [
+                {"site": "serve.solve", "kind": "transient",
+                 "times": 1, "prob": 1.0}]}))
+        errs0 = telemetry.registry().counter(
+            "serve.batch_errors").value()
+        r2 = mkreq(1)
+        assert b.submit(r2)["verdict"] == "accept"
+        assert r2.done.wait(timeout=120)
+        assert r2.error is not None \
+            and "Injected" in r2.error
+        assert telemetry.registry().counter(
+            "serve.batch_errors").value() == errs0 + 1
+
+        r3 = mkreq(2)        # the schedule is spent: service resumes
+        assert b.submit(r3)["verdict"] == "accept"
+        assert r3.done.wait(timeout=120)
+        assert r3.error is None
+        assert format_results(r3.results) == solo_and_golden(
+            corpus, r3.query_attrs, r3.ks)
+    finally:
+        b.stop(drain=True)
+        inject.uninstall()
+
+
 # -- protocol -----------------------------------------------------------------
 
 def test_protocol_parse_and_errors():

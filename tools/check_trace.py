@@ -54,6 +54,14 @@ request-scoped causal contract:
   canonical queue -> coalesce -> solve -> finalize -> write order
   (``serve.phase.admission`` is exempt: it runs on the handler thread
   concurrent with the queue wait);
+- ``slo.alert`` lifecycle (obs.slo transitions): every alert instant
+  carries an objective id, a state, and its window; per (process,
+  objective) the time-ordered transitions chain legally — each event's
+  ``prev`` equals the previous event's ``state`` (starting from
+  ``ok``) and every edge is one the hysteresis state machine can take
+  (ok→pending, pending→firing, pending→ok, firing→ok). A tampered or
+  out-of-order stream (a firing with no pending before it, a
+  firing→pending shortcut, a mismatched prev) fails the check;
 - when the merge embedded client-side reconcile verdicts: the
   reconciled fraction must reach ``--min-reconciled`` (default 0.9).
 
@@ -304,6 +312,7 @@ def check_fleet_trace(path: str, emit_json: bool = False,
     procs = fleet.get("processes", {})
     meta_by_pid, sync_by_pid, ts_by_pid = {}, set(), {}
     client_spans, routes, hop_spans, phase_spans = {}, {}, {}, {}
+    slo_alerts = {}                # (pid, objective) -> [instant...]
     for e in events:
         pid = e.get("pid")
         if pid is None:
@@ -320,6 +329,15 @@ def check_fleet_trace(path: str, emit_json: bool = False,
             ts_by_pid.setdefault(pid, []).append(e["ts"])
         if ph == "i" and e.get("name") == "fleet.clock_sync":
             sync_by_pid.add(pid)
+        if ph == "i" and e.get("name") == "slo.alert":
+            a = e.get("args", {})
+            for req in ("objective", "state", "window"):
+                if not a.get(req):
+                    fail(f"{path}: pid {pid} slo.alert at ts "
+                         f"{e.get('ts')} lacks {req!r} — alert "
+                         "unattributable")
+            slo_alerts.setdefault((pid, a["objective"]),
+                                  []).append(e)
         if ph != "X":
             continue
         a = e.get("args", {})
@@ -400,6 +418,30 @@ def check_fleet_trace(path: str, emit_json: bool = False,
                      f"{pa!r} ({tb} < {ta} us) — canonical phase order "
                      "violated")
 
+    # -- slo.alert lifecycle --------------------------------------------
+    # Legal hysteresis edges (obs.slo.SLOEvaluator.next_state): no
+    # ok->firing jump, no firing->pending shortcut. Each objective's
+    # stream must chain prev==last-state from "ok" in time order —
+    # anything else is a tampered or reordered alert stream.
+    legal = {("ok", "pending"), ("pending", "firing"),
+             ("pending", "ok"), ("firing", "ok")}
+    for (pid, objective), evs in sorted(slo_alerts.items()):
+        evs.sort(key=lambda e: e.get("ts", 0))
+        last = "ok"
+        for e in evs:
+            a = e.get("args", {})
+            prev, state = a.get("prev"), a.get("state")
+            if prev != last:
+                fail(f"{path}: pid {pid} objective {objective!r} "
+                     f"slo.alert at ts {e.get('ts')} claims prev="
+                     f"{prev!r} but the stream's state was {last!r} "
+                     "— out-of-order or tampered alert stream")
+            if (prev, state) not in legal:
+                fail(f"{path}: pid {pid} objective {objective!r} "
+                     f"illegal slo transition {prev!r} -> {state!r} "
+                     "(hysteresis lifecycle violated)")
+            last = state
+
     # -- reconcile verdicts ---------------------------------------------
     reconcile = fleet.get("reconcile", {})
     frac = reconcile.get("fraction")
@@ -421,12 +463,17 @@ def check_fleet_trace(path: str, emit_json: bool = False,
             "client_spans": len(client_spans),
             "retried_rids": retried,
             "phased_rids": len(phase_spans),
+            "slo_alerts": {f"{pid}:{obj}": len(evs)
+                           for (pid, obj), evs
+                           in sorted(slo_alerts.items())},
             "reconcile": reconcile or None,
             "clock": doc.get("clock"),
         }, sort_keys=True))
     say(f"check_trace: merged fleet trace ok — "
         f"{len(procs)} processes, {len(routes)} routed rid(s), "
-        f"{len(retried)} retried, reconcile "
+        f"{len(retried)} retried, "
+        f"{sum(len(v) for v in slo_alerts.values())} slo.alert(s) "
+        f"across {len(slo_alerts)} objective stream(s), reconcile "
         f"{reconcile.get('n_reconciled')}/{reconcile.get('n_requests')}"
         f" (fraction {frac})")
 

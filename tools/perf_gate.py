@@ -44,7 +44,7 @@ from dmlp_tpu.obs.ledger import build_ledger, series_deltas  # noqa: E402
 #: the r05->r06 transition keeps its round-over-round comparison; the
 #: "{kind}:" prefixes catch RunRecord series with no legacy ancestor.
 GATED_PREFIXES = ("harness/", "bench:", "bench/", "trainbench/", "serve/",
-                  "fleet/",
+                  "fleet/", "slo/",
                   "train:", "engine:", "roofline:", "capacity:",
                   "telemetry/", "prune/")
 
