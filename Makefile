@@ -7,8 +7,9 @@ CXXFLAGS ?= -O3 -Wall -shared -fPIC
 
 .PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
 	perf-gate check lint chaos-smoke telemetry-smoke serve-smoke \
-	race-smoke prune-smoke fleet-smoke fleet-chaos-smoke \
-	fleet-trace-smoke slo-smoke serve-bench fleet-bench clean
+	race-smoke prune-smoke precision-smoke fleet-smoke \
+	fleet-chaos-smoke fleet-trace-smoke slo-smoke serve-bench \
+	fleet-bench clean
 
 all: native
 
@@ -19,7 +20,8 @@ native/_fastparse.so: native/fastparse.cpp
 
 test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint \
 	chaos-smoke telemetry-smoke serve-smoke race-smoke prune-smoke \
-	fleet-smoke fleet-chaos-smoke fleet-trace-smoke slo-smoke
+	precision-smoke fleet-smoke fleet-chaos-smoke fleet-trace-smoke \
+	slo-smoke
 	python -m pytest tests/ -q
 
 # Static analysis + runtime-sanitizer smoke (README "Static analysis &
@@ -215,6 +217,17 @@ prune-smoke:
 	JAX_PLATFORMS=cpu python tools/prune_smoke.py --out outputs/prune
 	JAX_PLATFORMS=cpu BENCH_OUT=outputs/prune/CAPACITY_PRUNE_SMOKE.json \
 	  python tools/capacity_beyond_hbm.py --cpu-smoke > /dev/null
+
+# Low-precision first-pass smoke (README "Low-precision first pass"):
+# on the banded corpus, forced-bf16 and kill-switch-f32 CLI runs must
+# be byte-identical to each other and to the f64 golden model; the
+# bf16 arm's metrics must show an ACTIVE bf16 pass with a widened
+# (kcap-inflated) rescore window; and a seeded staging oom must step
+# the degrade ladder lowp->prune with byte-identical recovery.
+precision-smoke:
+	mkdir -p outputs/precision
+	JAX_PLATFORMS=cpu python tools/precision_smoke.py \
+	  --out outputs/precision
 
 # Serving-fleet smoke (README "Fleet serving"): a REAL fleet on CPU —
 # a plain resident replica + a mesh-resident replica (--mesh 2x1,

@@ -288,6 +288,7 @@ def run_config(config_id: int, base_dir: str = ".",
                obs_overhead: bool = False,
                fused_ab: bool = False,
                prune_ab: bool = False,
+               precision_ab: bool = False,
                telemetry_dir: Optional[str] = None) -> dict:
     """Full benchmark flow for one config; returns a result summary dict.
 
@@ -533,6 +534,24 @@ def run_config(config_id: int, base_dir: str = ".",
             from dmlp_tpu.obs.run import RunRecord, round_from_name
             RunRecord(kind="prune", tool="dmlp_tpu.bench",
                       config=_dc.asdict(cfg), metrics=dict(prune_res),
+                      device="cpu" if cpu_pinned else None,
+                      round=round_from_name(record_path)
+                      ).append_jsonl(record_path)
+    if precision_ab:
+        prec_res = _measure_precision_ab(
+            cfg, input_path, outputs_dir, out, mode=mode, fast=fast,
+            timeout_s=timeout_s, env=env, pairs=n_reps,
+            oracle_want=want if check_reps else None)
+        res.update(prec_res)
+        if record_path:
+            # A dedicated kind="precision" RunRecord so the A/B lands
+            # in the ledger's ``precision/configN/...`` family (gated
+            # by tools/perf_gate.py) alongside the plain bench record.
+            import dataclasses as _dc
+
+            from dmlp_tpu.obs.run import RunRecord, round_from_name
+            RunRecord(kind="precision", tool="dmlp_tpu.bench",
+                      config=_dc.asdict(cfg), metrics=dict(prec_res),
                       device="cpu" if cpu_pinned else None,
                       round=round_from_name(record_path)
                       ).append_jsonl(record_path)
@@ -847,6 +866,140 @@ def _measure_prune_ab(cfg: BenchConfig, input_path: str,
                   f"{sb_d} -> {sb_p}, "
                   f"{res['prune_blocks_pruned']}/"
                   f"{res['prune_blocks_total']} blocks pruned, "
+                  "byte-identical)\n")
+    return res
+
+
+def _measure_precision_ab(cfg: BenchConfig, input_path: str,
+                          outputs_dir: str, out: TextIO,
+                          mode: Optional[str], fast: bool,
+                          timeout_s: float, env: Optional[dict],
+                          pairs: int, oracle_want: Optional[str]) -> dict:
+    """Interleaved bf16-first-pass vs f32 engine timings:
+    ``DMLP_TPU_PRECISION=bf16`` against ``=f32``, order alternating per
+    pair (the repo's A/B weathering methodology). The record carries:
+
+    - ``engine_ms_bf16`` / ``engine_ms_f32`` medians plus raw
+      ``*_reps`` lists (ledger per-trial evidence -> a gated
+      ``precision/configN/...`` series);
+    - ``precision_ab_identical``: every bf16-arm stdout byte-equal to
+      every f32-arm stdout (and the oracle in exact mode) — the
+      low-precision pass's byte-identity contract (lowp_eps-inflated
+      windows + unchanged f64 rescore), CHECKED per run, not assumed.
+      A mismatch withholds the timings: a wrong-output arm must never
+      become a ledger point;
+    - ``precision_kcap_f32`` / ``precision_kcap_bf16`` /
+      ``precision_kcap_inflation`` from the engines' per-arm
+      ``precision`` summary blocks (engine.last_precision) — the
+      window-inflation cost of the bound, as a checked number.
+
+    The A/B is never VACUOUS: the bf16 arm's summary must report
+    ``active == "bf16"`` — a fast-mode run (precision resolves f32
+    when there is no rescore backstop) or an engine without the lowp
+    rung records the explicit ``precision_ab_unavailable`` marker
+    instead of an identical-code pair masquerading as a gated series.
+
+    Never raises: failures record ``precision_ab_unavailable``."""
+    import json
+    import statistics
+
+    if cfg.procs > 1:
+        return {"precision_ab_unavailable": "multi-process config (the "
+                "A/B drives the single-process engine CLI)"}
+    base_env = dict(env if env is not None else os.environ)
+    arm_env = {"bf16": "bf16", "f32": "f32"}
+    times: dict = {a: [] for a in arm_env}
+    outputs: dict = {a: set() for a in arm_env}
+    metrics_paths = {
+        arm: os.path.join(
+            outputs_dir,
+            f"precision_ab_metrics_{arm}_c{cfg.config_id}.jsonl")
+        for arm in arm_env}
+    for mpath in metrics_paths.values():
+        if os.path.exists(mpath):   # metrics JSONL appends; start clean
+            os.remove(mpath)
+    try:
+        for rep in range(max(pairs, 1)):
+            order = ("f32", "bf16") if rep % 2 == 0 \
+                else ("bf16", "f32")
+            for arm in order:
+                e = dict(base_env)
+                e["DMLP_TPU_PRECISION"] = arm_env[arm]
+                out_path, err_path = run_engine(
+                    cfg, input_path, outputs_dir, mode=mode, fast=fast,
+                    timeout_s=timeout_s, env=e,
+                    obs_flags=["--metrics", metrics_paths[arm]])
+                with open(out_path) as f:
+                    outputs[arm].add(f.read())
+                with open(err_path) as f:
+                    ms = _extract_ms(f.read())
+                if ms is None:
+                    return {"precision_ab_unavailable":
+                            f"no timing line in the {arm}-arm run"}
+                times[arm].append(ms)
+    except (EngineTimeout, RuntimeError) as e:
+        return {"precision_ab_unavailable":
+                f"engine run failed during the A/B: {e}"}
+    identical = (len(outputs["bf16"]) == 1
+                 and outputs["bf16"] == outputs["f32"]
+                 and (oracle_want is None
+                      or outputs["bf16"] == {oracle_want}))
+    if not identical:
+        return {"precision_ab_unavailable":
+                "bf16/f32 stdout MISMATCH — byte-identity contract "
+                "violated; timings withheld",
+                "precision_ab_identical": False}
+    prec_blocks: dict = {}
+    for arm, mpath in metrics_paths.items():
+        try:
+            with open(mpath) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec.get("event") == "summary" \
+                            and isinstance(rec.get("precision"), dict):
+                        prec_blocks[arm] = rec["precision"]
+        except (OSError, ValueError) as e:
+            return {"precision_ab_identical": True,
+                    "precision_ab_unavailable":
+                        f"{arm}-arm metrics channel unreadable: {e}"}
+    if set(prec_blocks) != set(arm_env):
+        return {"precision_ab_identical": True,
+                "precision_ab_unavailable":
+                    "no precision block in the A/B metrics channel — "
+                    "cannot attribute the arms to first-pass dtypes"}
+    if prec_blocks["bf16"].get("active") != "bf16":
+        # Identical arms AND the bf16 arm never cast: the pair measured
+        # the same code twice. An honest marker, not a ledger series.
+        return {"precision_ab_vacuous": True,
+                "precision_ab_identical": True,
+                "precision_ab_unavailable":
+                    "the DMLP_TPU_PRECISION=bf16 arm ran with active "
+                    f"precision {prec_blocks['bf16'].get('active')!r} "
+                    "(fast mode, or an engine without the lowp rung) — "
+                    "an identical-code A/B must not become a gated "
+                    "series"}
+    med_b = statistics.median(times["bf16"])
+    med_f = statistics.median(times["f32"])
+    res = {"precision_ab_identical": True,
+           "engine_ms_bf16": round(med_b),
+           "engine_ms_bf16_reps": times["bf16"],
+           "engine_ms_f32": round(med_f),
+           "engine_ms_f32_reps": times["f32"]}
+    for arm in arm_env:
+        kcap = prec_blocks[arm].get("kcap")
+        if kcap is not None:
+            res[f"precision_kcap_{arm}"] = kcap
+    infl = prec_blocks["bf16"].get("kcap_inflation")
+    if infl is not None:
+        res["precision_kcap_inflation"] = infl
+    if med_f > 0:
+        pct = (med_b - med_f) / med_f * 100.0
+        res["precision_ab_pct"] = round(pct, 2)
+        out.write(f"Config {cfg.config_id}: precision A/B {pct:+.1f}% "
+                  f"(median {med_f} -> {med_b} ms over "
+                  f"{len(times['bf16'])} interleaved pair(s), kcap "
+                  f"{res.get('precision_kcap_f32', '?')} -> "
+                  f"{res.get('precision_kcap_bf16', '?')}, "
                   "byte-identical)\n")
     return res
 
@@ -1175,6 +1328,15 @@ def main(argv=None) -> int:
                         "scanned-bytes both ways (+ raw rep lists) as "
                         "a kind=\"prune\" RunRecord per config "
                         "(single-process configs)")
+    p.add_argument("--precision-ab", action="store_true",
+                   help="A/B the low-precision first pass: run "
+                        "interleaved DMLP_TPU_PRECISION=bf16/f32 "
+                        "engine pairs, verify the arms byte-identical "
+                        "(and vs the oracle in exact mode), and record "
+                        "engine_ms_bf16 / engine_ms_f32 plus the "
+                        "kcap window inflation (+ raw rep lists) as a "
+                        "kind=\"precision\" RunRecord per config "
+                        "(single-process configs)")
     p.add_argument("--serve-trace", metavar="FILE", default=None,
                    help="recorded query trace for the serve mode "
                         "(default inputs/serve_trace1.jsonl)")
@@ -1207,6 +1369,7 @@ def main(argv=None) -> int:
                          obs_overhead=args.obs_overhead,
                          fused_ab=args.fused_ab,
                          prune_ab=args.prune_ab,
+                         precision_ab=args.precision_ab,
                          telemetry_dir=args.telemetry_dir)
         # `timed_out` is a marker, not a verdict (markers never gate):
         # the config's RunRecord documents the hang; a wrong checksum

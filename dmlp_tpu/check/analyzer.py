@@ -26,9 +26,9 @@ from dmlp_tpu.check.common import ModuleInfo
 from dmlp_tpu.check.facts import PackageFacts, module_facts
 from dmlp_tpu.check.findings import Finding
 
-ALL_FAMILIES = ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7")
+ALL_FAMILIES = ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
 #: families make check enforces by default; R0 rides in `make lint`
-DEFAULT_FAMILIES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
+DEFAULT_FAMILIES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
 
 
 def package_root() -> str:
@@ -90,6 +90,7 @@ def build_rules(facts: PackageFacts,
     from dmlp_tpu.check.dispatchcost import DispatchCostRule
     from dmlp_tpu.check.hostsync import HostSyncRule
     from dmlp_tpu.check.hygiene import HygieneRule
+    from dmlp_tpu.check.lowprec import LowPrecRule
     from dmlp_tpu.check.metricnames import MetricNameRule
     from dmlp_tpu.check.recompile import RecompileRule
     from dmlp_tpu.check.resilient import ResilientRule
@@ -113,6 +114,8 @@ def build_rules(facts: PackageFacts,
         rules.append(MetricNameRule(facts))
     if "R7" in fams:
         rules.append(ConcurrencyRule(facts.concurrency))
+    if "R8" in fams:
+        rules.append(LowPrecRule(facts))
     return rules
 
 

@@ -76,6 +76,8 @@ class PackageFacts:
         self.axis_helpers: Dict[str, int] = {}
         modeled: Set[str] = set()
         saw_kernel_cost = False
+        eps_fns: Set[str] = set()
+        saw_finalize = False
         metric_sites: List[Tuple[str, int, str, str]] = []
         conc_pairs: List[Tuple[str, Dict[str, Any]]] = []
         for rel, facts in self.pairs:
@@ -90,6 +92,10 @@ class PackageFacts:
             if rel_n.endswith("obs/kernel_cost.py"):
                 saw_kernel_cost = True
                 modeled.update(facts.get("modeled_kernels", []))
+            if rel_n.endswith("engine/finalize.py"):
+                saw_finalize = True
+                eps_fns.update(n for n in facts.get("defs", [])
+                               if "eps" in n)
             for seq, (name, kind) in enumerate(
                     facts.get("metric_sites", [])):
                 metric_sites.append((rel, seq, name, kind))
@@ -116,6 +122,15 @@ class PackageFacts:
         else:
             self.modeled_kernels = _installed_modeled_kernels()
             self._fallback_models = sorted(self.modeled_kernels or [])
+        #: eps-bound function names defined by engine/finalize.py; the
+        #: R803 validation table. Same installed-package fallback (and
+        #: same fold-into-digest obligation) as the kernel model table.
+        self._fallback_eps: Optional[List[str]] = None
+        if saw_finalize:
+            self.eps_models: Optional[Set[str]] = eps_fns or None
+        else:
+            self.eps_models = _installed_eps_models()
+            self._fallback_eps = sorted(self.eps_models or [])
         self.concurrency = ConcurrencyGraph(conc_pairs)
 
     def digest(self) -> str:
@@ -123,7 +138,8 @@ class PackageFacts:
         per-file findings cache key (a change to any file's FACTS
         invalidates every file's findings; a facts-neutral edit only
         invalidates the edited file)."""
-        blob = json.dumps([self.pairs, self._fallback_models],
+        blob = json.dumps([self.pairs, self._fallback_models,
+                           self._fallback_eps],
                           sort_keys=True,
                           separators=(",", ":")).encode()
         return hashlib.sha256(blob).hexdigest()
@@ -139,6 +155,21 @@ def _installed_modeled_kernels() -> Optional[Set[str]]:
     except (OSError, SyntaxError):
         return None
     names = set(_modeled_from_tree(tree))
+    return names or None
+
+
+def _installed_eps_models() -> Optional[Set[str]]:
+    import os
+    try:
+        from dmlp_tpu.check.analyzer import package_root
+        path = os.path.join(package_root(), "engine", "finalize.py")
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    names = {n.name for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+             and "eps" in n.name}
     return names or None
 
 

@@ -68,6 +68,14 @@ RULES = {
             " readback, thread join) while holding a lock",
     "R704": "thread started without a join/stop path or a daemon"
             " declaration",
+    # R8 — low-precision MXU contract (ops/pallas_*.py)
+    "R801": "dot/dot_general without explicit preferred_element_type"
+            " (accumulator follows operand dtype; bf16 accumulation"
+            " voids the lowp_eps exactness bound)",
+    "R802": "sub-f32 operand cast without a `# check: lowp-eps=<fn>`"
+            " annotation naming its analytic error bound",
+    "R803": "lowp-eps annotation names a function engine/finalize.py"
+            " does not define",
 }
 
 #: rule id -> allowlist directive that silences it at a call site.
@@ -80,6 +88,7 @@ ALLOW_DIRECTIVES = {
     "R5": "no-retry",
     "R6": "allow-metric-name",
     "R7": "allow-concurrency",
+    "R8": "allow-lowprec",
 }
 
 #: every directive that SUPPRESSES a finding (for ``--stale-allows``):

@@ -1,0 +1,162 @@
+"""R8 — low-precision MXU contract in the Pallas kernel bodies.
+
+The low-precision first pass (``precision="bf16"``) is exact only
+because of a two-part contract: every matmul keeps its accumulator in
+f32 (``preferred_element_type=jnp.float32`` — a bf16 accumulator would
+void the :func:`~dmlp_tpu.engine.finalize.lowp_eps` bound entirely),
+and every site that casts streamed operands below f32 declares *which*
+analytic error bound covers the cast, so the resolver/prune window
+inflation can be audited from the kernel source alone. Both halves are
+mechanical to check and silent to violate — a missing
+``preferred_element_type`` still returns plausible neighbours, just no
+longer byte-identical ones — which is exactly the profile a static
+rule should carry instead of a fuzz test alone.
+
+Scope: the Pallas kernel modules, ``dmlp_tpu/ops/pallas_*.py``.
+
+- **R801** ``dot``/``dot_general`` call without an explicit
+  ``preferred_element_type`` keyword (accumulator dtype left to the
+  backend default, which follows the *operand* dtype — bf16 operands
+  would get a low-precision accumulator).
+- **R802** cast of an operand to a sub-f32 dtype (``bfloat16``,
+  ``float16``, ``int8``, …) without a governing
+  ``# check: lowp-eps=<fn>`` annotation naming the bound that covers
+  it.
+- **R803** a ``lowp-eps=<fn>`` annotation naming a function
+  ``engine/finalize.py`` does not define — the declared bound must
+  exist, or the annotation documents nothing.
+
+``# check: allow-lowprec`` waives a site (e.g. a deliberately lossy
+diagnostic kernel outside the exactness contract).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Optional
+
+from dmlp_tpu.check.common import ModuleInfo, call_name, dotted
+from dmlp_tpu.check.facts import PackageFacts
+from dmlp_tpu.check.findings import Finding
+
+ALLOW = "allow-lowprec"
+EPS_DIRECTIVE = "lowp-eps"
+
+#: dtype name suffixes that count as "below f32" when cast to. int4/
+#: fp8 spellings are included pre-emptively: the int8 first pass is a
+#: roadmap follow-on and its cast sites must land already annotated.
+_LOW_DTYPES = ("bfloat16", "float16", "half", "int8", "uint8", "int4",
+               "float8_e4m3fn", "float8_e5m2")
+
+#: call names (last dotted segment) that hit the MXU and therefore
+#: need an explicit accumulator dtype.
+_DOT_CALLS = ("dot", "dot_general")
+
+
+def in_lowprec_scope(mod: ModuleInfo) -> bool:
+    rel = mod.relpath.replace("\\", "/")
+    return fnmatch.fnmatch(rel, "*dmlp_tpu/ops/pallas_*.py") \
+        or fnmatch.fnmatch(rel, "dmlp_tpu/ops/pallas_*.py")
+
+
+def _dtype_name(node: ast.AST) -> Optional[str]:
+    """``jnp.bfloat16`` -> "bfloat16"; ``"bfloat16"`` -> itself."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    name = dotted(node)
+    if name is not None:
+        return name.split(".")[-1]
+    return None
+
+
+def _low_cast_dtype(call: ast.Call) -> Optional[str]:
+    """The sub-f32 dtype a call casts to, else None.
+
+    Recognizes ``x.astype(dt)`` and ``lax.convert_element_type(x, dt)``
+    (positional or ``new_dtype=``) — the two spellings the kernels use.
+    """
+    name = call_name(call)
+    if name is None:
+        return None
+    tail = name.split(".")[-1]
+    cand: Optional[ast.AST] = None
+    if tail == "astype" and call.args:
+        cand = call.args[0]
+    elif tail == "convert_element_type":
+        cand = call.args[1] if len(call.args) > 1 else None
+        for kw in call.keywords:
+            if kw.arg == "new_dtype":
+                cand = kw.value
+    if cand is None:
+        return None
+    dt = _dtype_name(cand)
+    if dt is not None and dt in _LOW_DTYPES:
+        return dt
+    return None
+
+
+class LowPrecRule:
+    def __init__(self, facts: PackageFacts):
+        self._eps_fns = facts.eps_models
+
+    def run(self, mod: ModuleInfo, add) -> None:
+        if not in_lowprec_scope(mod):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            tail = name.split(".")[-1]
+            if tail in _DOT_CALLS:
+                self._check_dot(mod, node, name, add)
+            dt = _low_cast_dtype(node)
+            if dt is not None:
+                self._check_cast(mod, node, dt, add)
+
+    def _check_dot(self, mod: ModuleInfo, node: ast.Call, name: str,
+                   add) -> None:
+        if any(kw.arg == "preferred_element_type"
+               for kw in node.keywords):
+            return
+        if mod.allowed(node, ALLOW):
+            return
+        add(Finding(
+            "R801", mod.relpath, node.lineno, node.col_offset,
+            mod.scope_of(node), f"dot-no-acc-dtype:{name}",
+            f"`{name}` without an explicit `preferred_element_type` — "
+            "the accumulator dtype follows the operand dtype, so a "
+            "bf16 first pass would accumulate in bf16 and void the "
+            "lowp_eps exactness bound; pin `preferred_element_type="
+            "jnp.float32`"))
+
+    def _check_cast(self, mod: ModuleInfo, node: ast.Call, dt: str,
+                    add) -> None:
+        declared = mod.directive_values(node, EPS_DIRECTIVE)
+        if not declared:
+            if mod.allowed(node, ALLOW):
+                return
+            add(Finding(
+                "R802", mod.relpath, node.lineno, node.col_offset,
+                mod.scope_of(node), f"lowp-cast-unbounded:{dt}",
+                f"operand cast to `{dt}` without a `# check: "
+                "lowp-eps=<fn>` annotation naming the analytic bound "
+                "that covers the precision loss (engine/finalize.py)"))
+            return
+        known = self._eps_fns
+        if known is None:
+            return          # finalize.py facts unavailable: stay silent
+        for fn in declared:
+            if fn in known:
+                continue
+            if mod.allowed(node, ALLOW):
+                continue
+            add(Finding(
+                "R803", mod.relpath, node.lineno, node.col_offset,
+                mod.scope_of(node), f"lowp-eps-unknown:{fn}",
+                f"`lowp-eps={fn}` names a bound engine/finalize.py "
+                "does not define — the annotation must reference a "
+                "real eps function so the inflation it promises can "
+                "be audited"))

@@ -82,7 +82,8 @@ def _emit_metrics(path: str, args, inp, timer: EngineTimer, phase_ms: dict,
                   counters: Optional[dict], comms: Optional[dict],
                   extract_impl: Optional[str] = None,
                   mem_model: Optional[dict] = None,
-                  prune: Optional[dict] = None) -> None:
+                  prune: Optional[dict] = None,
+                  precision: Optional[dict] = None) -> None:
     """Append per-phase records + one run summary to the metrics JSONL.
 
     The summary is the contract record: it always carries a ``counters``
@@ -123,6 +124,13 @@ def _emit_metrics(path: str, args, inp, timer: EngineTimer, phase_ms: dict,
             # solve (ops.summaries.note_scan) — the bench --prune-ab
             # harness and `make prune-smoke` read these per arm.
             summary["prune"] = prune
+        if precision is not None:
+            # First-pass precision record (engine.last_precision:
+            # active/configured precision, kcap, window inflation) —
+            # the bench --precision-ab harness reads this per arm to
+            # refuse recording a vacuous (never-cast-bf16) pair, and
+            # `make precision-smoke` asserts the inflation is visible.
+            summary["precision"] = precision
         # Recovery is never silent: when the resilience layer did
         # anything (or a fault schedule was installed, even if nothing
         # fired), the summary carries the counters the chaos harness
@@ -386,6 +394,9 @@ def _run_cli(parser, args, stdin, stdout, stderr, tracer, probe) -> int:
                           if engine is not None else None,
                           mem_model=mem_model,
                           prune=getattr(engine, "last_prune", None)
+                          if engine is not None else None,
+                          precision=getattr(engine, "last_precision",
+                                            None)
                           if engine is not None else None)
         if args.counters:
             _emit_counters_stderr(counters, timer.elapsed_ms, stderr)

@@ -70,6 +70,20 @@ class EngineConfig:
       debug: human-readable output instead of checksums — the -DDEBUG
         build of the reference (common.cpp:72-78).
       use_pallas: use the fused Pallas distance kernel where available.
+      precision: FIRST-PASS dot precision for the extract-path kernels
+        ("auto" | "f32" | "bf16"). "bf16" casts the streamed q/d tiles
+        before the MXU dot (one pass vs HIGHEST-precision f32's ~3)
+        with f32 accumulation kept; the engines widen every candidate
+        window / prune threshold / hazard test by the analytic
+        engine.finalize.lowp_eps bound so the unchanged f64 rescore +
+        boundary repair keeps results byte-identical to the f32 dense
+        scan. Active only in exact mode on the resilience ladder's top
+        "lowp" rung (fast mode's output IS the device ordering — no
+        repair backstop). "auto" resolves to "f32" (opt-in: the win is
+        MXU throughput, which a CPU container cannot show).
+        $DMLP_TPU_PRECISION overrides at resolve time ("f32" = kill
+        switch, "bf16" = force). int8 is the gated follow-on (ROADMAP):
+        its bound needs data-dependent quantization scales.
     """
 
     AUTO_SELECT_THRESHOLD = 8192
@@ -84,12 +98,17 @@ class EngineConfig:
     select: str = "auto"
     debug: bool = False
     use_pallas: bool = False
+    precision: str = "auto"
 
     def __post_init__(self) -> None:
         if self.mode not in ("single", "sharded", "ring"):
             raise ValueError(f"unknown mode {self.mode!r}")
         if self.dtype not in ("auto", "float32", "bfloat16"):
             raise ValueError(f"unsupported dtype {self.dtype!r}")
+        if self.precision not in ("auto", "f32", "bf16"):
+            raise ValueError(
+                f"unsupported first-pass precision {self.precision!r} "
+                "(int8 is the gated follow-on — see ROADMAP)")
         if self.select not in ("auto", "sort", "topk", "seg", "extract"):
             raise ValueError(f"unknown select {self.select!r}")
         if (self.data_block is not None and self.data_block <= 0) \
@@ -114,6 +133,25 @@ class EngineConfig:
         except Exception:
             return "float32"
         return "bfloat16" if platform == "tpu" else "float32"
+
+    def resolve_precision(self) -> str:
+        """Concrete first-pass precision ("f32" | "bf16") for this run,
+        env override included: ``$DMLP_TPU_PRECISION`` wins when set to
+        a legal value ("f32" doubles as the kill switch, "bf16" forces
+        the low-precision pass on), else the configured value, with
+        "auto" resolving to "f32". Read per call (no import-time
+        snapshot) so tests and operators can flip the env without
+        re-imports — the engines resolve it OUTSIDE every jit and key
+        their compiled programs on the result (R2 discipline). Fast
+        mode always runs "f32": the low-precision pass is only sound
+        with the f64 rescore + boundary repair behind it."""
+        import os
+        if not self.exact:
+            return "f32"
+        env = os.environ.get("DMLP_TPU_PRECISION")
+        if env in ("f32", "bf16"):
+            return env
+        return "f32" if self.precision == "auto" else self.precision
 
     def resolve_select(self, padded_rows: int) -> str:
         """Concrete selection strategy for a dataset of ``padded_rows``."""

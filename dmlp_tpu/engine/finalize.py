@@ -81,6 +81,41 @@ EPS_REL_BF16 = 2.0 ** -6
 EPS_REL_F32 = 2.0 ** -21
 EPS_CANCEL_COEF = 3.0 * 2.0 ** -22
 
+#: Low-precision FIRST-PASS coefficients (the tentpole's ``lowp_eps``
+#: bound): casting the streamed q/d tiles to the pass dtype perturbs
+#: each operand by a relative half-ulp u (2^-8 for bfloat16's 7
+#: explicit mantissa bits), so the f32-accumulated cross term errs by
+#: at most (2u + u^2) * |q||d| <= (2u + u^2) * (qn + dn)/2 per dot
+#: (AM-GM), i.e. the norm-expansion distance by (2u + u^2)(qn + dn) —
+#: a bound on the MAGNITUDE scale, independent of the distance itself
+#: (unlike staging_eps term 1, which shrinks with sqrt(dist)). The
+#: coefficient folds the 2u, the second-order u^2, and a 2x safety
+#: slack: 2^-6 = 8 * 2^-9 >= (2*2^-8 + 2^-16) * 2. f32 is the exact
+#: pass (zero cast error — the f32 accumulation itself is already
+#: covered by the EPS_CANCEL_COEF term everywhere this composes).
+#: tests/test_precision.py fuzzes the bound with directed adversarial
+#: magnitude-cancellation corpora. int8 has NO entry: an int8 pass
+#: needs data-dependent quantization scales, so its bound cannot be a
+#: static coefficient — the ROADMAP follow-on.
+LOWP_COEF = {"f32": 0.0, "bf16": 2.0 ** -6}
+
+
+def lowp_eps(precision: str, qn: np.ndarray, dn_max: float) -> np.ndarray:
+    """Per-query bound on the distance perturbation a low-precision
+    FIRST PASS (ops.pallas_extract with ``precision != "f32"``) can add
+    on top of the staging/f32 terms: ``LOWP_COEF[precision] * (qn +
+    dn_max)``. Composes ADDITIVELY with :func:`staging_eps` (the cast
+    error of the pass dtype and the staging/accumulation errors act on
+    the same computed distance, so their bounds sum) at every decision
+    the low-precision distances feed: the truncation-hazard test, the
+    prune thresholds, the MXU-gate bound, and the multi-pass floor.
+    Zero for the exact "f32" pass. Raises KeyError on a precision with
+    no static bound (int8 — see LOWP_COEF)."""
+    coef = LOWP_COEF[precision]
+    if not coef:
+        return np.zeros_like(np.asarray(qn, np.float64))
+    return coef * (np.asarray(qn, np.float64) + dn_max)
+
 
 def staging_eps(last: np.ndarray, qn: np.ndarray, dn_max: float,
                 staging: str, na: int) -> np.ndarray:

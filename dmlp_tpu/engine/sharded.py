@@ -30,7 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlp_tpu.config import EngineConfig
 from dmlp_tpu.engine.finalize import (boundary_overflow, finalize_host,
-                                      repair_boundary_overflow, staging_eps)
+                                      lowp_eps, repair_boundary_overflow,
+                                      staging_eps)
 from dmlp_tpu.engine.single import (ChunkThrottle, MeasuredIters,
                                     fit_blocks, flush_measured_iters,
                                     pad_dataset, resilient_get,
@@ -113,6 +114,10 @@ class ShardedEngine:
         # Pruned two-stage solve accounting (ops.summaries.note_scan);
         # None until a staging path runs.
         self.last_prune = None
+        # First-pass precision record of the last solve ({"active",
+        # "configured"}); None until _solve_segments runs. The mesh
+        # engines have no resilience ladder, so active == configured.
+        self.last_precision = None
 
     def _np_dtype(self):
         """Wire dtype from the engine's (possibly no_auto_coarsen-swapped)
@@ -187,7 +192,7 @@ class ShardedEngine:
 
     # -- the compiled sharded program ---------------------------------------
     def _solve_shard_fn(self, k: int, data_block: int, select: str,
-                        impl: str = "extract"):
+                        impl: str = "extract", precision: str = "f32"):
         """Per-cell solver closure: the flagship fused/extraction kernel
         when the plan selected it (its SMEM runtime scalars make the
         per-shard id_base/n_real traced values, so one compiled kernel
@@ -218,7 +223,8 @@ class ShardedEngine:
                 base = jnp.maximum(data_i[0], 0)
                 od, oi, its = kern(q_attrs, data_a, n_real=nreal,
                                    id_base=base, kc=k,
-                                   interpret=interpret)
+                                   interpret=interpret,
+                                   precision=precision)
                 lab = jnp.where(
                     oi >= 0, data_l[jnp.clip(oi - base, 0, sr - 1)], -1)
                 return TopK(od, lab, oi), \
@@ -235,11 +241,15 @@ class ShardedEngine:
         return solve_shard
 
     def _fn(self, k: int, data_block: int, select: str,
-            impl: str = "extract"):
-        key = (k, data_block, select, impl)
+            impl: str = "extract", precision: str = "f32"):
+        # ``precision`` (the first-pass dot dtype, resolved OUTSIDE the
+        # jit like impl) keys every compiled program that bakes a
+        # kernel dispatch in — R2 discipline, same contract as impl.
+        key = (k, data_block, select, impl, precision)
         if key not in self._fns:
             merge = self._merge_strategy
-            solve_shard = self._solve_shard_fn(k, data_block, select, impl)
+            solve_shard = self._solve_shard_fn(k, data_block, select, impl,
+                                               precision)
 
             def local(data_a, data_l, data_i, q_attrs):
                 top, its = solve_shard(data_a, data_l, data_i, q_attrs)
@@ -296,7 +306,7 @@ class ShardedEngine:
 
     # -- pipelined chunked staging (VERDICT r3 item 1) -----------------------
     def _chunk_fold_fn(self, k: int, interpret: bool,
-                       impl: str = "extract"):
+                       impl: str = "extract", precision: str = "f32"):
         """Per-chunk fold program: every (row, col) cell folds its slice of
         the staged chunk into its running (qloc, K) lists with the
         fused/extraction kernel (``impl``, resolved by _extract_impl
@@ -304,7 +314,7 @@ class ShardedEngine:
         shard_rows]`` rides as traced
         scalars (the kernel takes them in SMEM), so ONE compiled program
         serves every chunk of every input at the same shapes."""
-        key = ("chunkfold", k, interpret, impl)
+        key = ("chunkfold", k, interpret, impl, precision)
         if key not in self._fns:
             from dmlp_tpu.ops.pallas_extract import extract_topk
             from dmlp_tpu.ops.pallas_fused import fused_topk
@@ -322,7 +332,8 @@ class ShardedEngine:
                 n_real = jnp.where(live[0] > 0, n_real, 0)
                 od, oi, its = kern(q_attrs, chunk_a, cd[0], ci[0],
                                    n_real=n_real, id_base=id_base,
-                                   kc=k, interpret=interpret)
+                                   kc=k, interpret=interpret,
+                                   precision=precision)
                 # Per-cell summed kernel loop iterations ride out as a
                 # third fold output ((R, C) after shard_map) so the
                 # measured extraction term covers the mesh path too.
@@ -447,7 +458,7 @@ class ShardedEngine:
 
     def _plan_prune_mesh(self, inp: KNNInput, r: int, shard_rows: int,
                          nchunks: int, chunk_rows: int,
-                         allow_prune: bool):
+                         allow_prune: bool, precision: str = "f32"):
         """Stage 0+1 for the mesh chunk driver: per-(shard, chunk)
         survivor mask ((R, T) bool) + stats, or (None, None) when
         pruning is inactive. Blocks are each shard's chunk-aligned
@@ -470,11 +481,13 @@ class ShardedEngine:
         with obs_span("sharded.prune_score", blocks=len(ranges)):
             summ = osum.build_summaries(inp.data_attrs, ranges)
             keep, stats = osum.prune_mask(inp.query_attrs, inp.ks, summ,
-                                          staging=self._staging)
+                                          staging=self._staging,
+                                          precision=precision)
         return keep.reshape(r, nchunks), stats
 
     def _solve_chunked_extract(self, inp: KNNInput, routed: bool = True,
-                               allow_prune: bool = False):
+                               allow_prune: bool = False,
+                               precision: str = "f32"):
         """Chunked staging + per-chunk extract folds over the mesh.
 
         The r3 mesh engines staged the full padded dataset in ONE
@@ -559,7 +572,7 @@ class ShardedEngine:
             np.ascontiguousarray(inp.labels, np.int32), rsh)
 
         cd, ci = self._chunk_init_fn(r, qpad, k)()
-        step = self._chunk_fold_fn(k, interpret, impl)
+        step = self._chunk_fold_fn(k, interpret, impl, precision)
 
         ostep = None
         if split is not None:
@@ -580,7 +593,8 @@ class ShardedEngine:
         # all. ``None`` keep == dense scan, one compiled program either
         # way (the mask is a data input, not a cache key).
         keep_m, prune_stats = self._plan_prune_mesh(
-            inp, r, shard_rows, nchunks, chunk_rows, allow_prune)
+            inp, r, shard_rows, nchunks, chunk_rows, allow_prune,
+            precision)
         lsh = NamedSharding(self.mesh, P(DATA_AXIS))
         ones_live = jax.device_put(np.ones(r, np.int32), lsh)
         n_disp = nchunks if keep_m is None \
@@ -713,7 +727,8 @@ class ShardedEngine:
         return out_np
 
     def _solve_merged(self, k: int, data_block: int, select: str,
-                      d_attrs, d_labels, d_ids, q_attrs):
+                      d_attrs, d_labels, d_ids, q_attrs,
+                      precision: str = "f32"):
         """Dispatch the monolithic merged program, with obs hooks: the
         dispatch is recorded for cost-analysis counters and the merge's
         collective traffic is accounted from the dispatched shapes."""
@@ -721,7 +736,8 @@ class ShardedEngine:
         impl = self._extract_impl(select, q_attrs.shape[0] // c,
                                   d_attrs.shape[0] // r,
                                   d_attrs.shape[1], k)
-        fn = self._fn(k, data_block, select, impl)
+        fn = self._fn(k, data_block, select, impl,
+                      precision if select == "extract" else "f32")
         args = (d_attrs, d_labels, d_ids, q_attrs)
         obs_counters.record_dispatch(fn, args, site="sharded.solve_merge")
         self.last_comms = engine_comms(self._merge_strategy, (r, c),
@@ -767,10 +783,17 @@ class ShardedEngine:
         self._pending_iters = []
         self.last_extract_impl = None
         self.last_prune = None
-        # Pruning rides the exact contract path only: the f64 rescore +
-        # boundary repair are the backstop the soundness margin leans on.
+        # Pruning and the low-precision first pass ride the exact
+        # contract path only: the f64 rescore + boundary repair are the
+        # backstop both soundness margins lean on. The mesh engines
+        # have no resilience ladder, so the config-resolved precision
+        # (resolve_precision returns "f32" in fast mode) IS the active
+        # one; _run widens its hazard eps to match.
+        prec = self.config.resolve_precision()
+        self.last_precision = {"active": prec, "configured": prec}
         out = self._solve_chunked_extract(inp,
-                                          allow_prune=self.config.exact)
+                                          allow_prune=self.config.exact,
+                                          precision=prec)
         if isinstance(out, list):
             return out
         if out is not None:
@@ -781,7 +804,7 @@ class ShardedEngine:
             inp, data_block, qgran)
         self._last_select = select
         top = self._solve_merged(k, data_block, select, d_attrs, d_labels,
-                                 d_ids, q_attrs)
+                                 d_ids, q_attrs, precision=prec)
         return [(top, q_attrs.shape[0], None, select)]
 
     def solve_global(self, d_attrs, d_labels, d_ids, q_attrs, kmax: int):
@@ -851,16 +874,17 @@ class ShardedEngine:
 
     # -- per-shard program (no cross-shard merge) ---------------------------
     def _fn_local(self, k: int, data_block: int, select: str,
-                  impl: str = "extract"):
+                  impl: str = "extract", precision: str = "f32"):
         """Compiled per-cell top-k with out_specs keeping BOTH mesh axes:
         output (R, Qpad, K) sharded P("data", "query", None). No collective
         runs inside the jit — the multi-host contract path rescores each
         data shard's candidates in float64 on the process that owns the
         shard, then merges on host (parallel.distributed), so the exact
         merge must not happen in f32 on device first."""
-        key = ("local", k, data_block, select, impl)
+        key = ("local", k, data_block, select, impl, precision)
         if key not in self._fns:
-            solve_shard = self._solve_shard_fn(k, data_block, select, impl)
+            solve_shard = self._solve_shard_fn(k, data_block, select, impl,
+                                               precision)
 
             def local(data_a, data_l, data_i, q_attrs):
                 top, its = solve_shard(data_a, data_l, data_i, q_attrs)
@@ -971,6 +995,13 @@ class ShardedEngine:
                     eps = staging_eps(
                         np.asarray(dists[:, -1], np.float64), qn, dn_max,
                         self._staging, inp.params.num_attrs)
+                    prec = (self.last_precision or {}).get("active", "f32")
+                    if prec == "bf16" and select == "extract":
+                        # The bf16 first pass perturbs device distances
+                        # beyond the staging model; the hazard test must
+                        # not trust a boundary the low-precision dot
+                        # could have reordered (finalize.lowp_eps).
+                        eps = eps + lowp_eps("bf16", qn, dn_max)
                     suspects = np.nonzero(
                         boundary_overflow(dists, sub.ks, eps))[0]
                     if suspects.size:
@@ -988,14 +1019,16 @@ class ShardedEngine:
         return merged
 
     def _fn_full(self, k: int, data_block: int, select: str,
-                 num_labels: int, impl: str = "extract"):
+                 num_labels: int, impl: str = "extract",
+                 precision: str = "f32"):
         """Compiled all-device pipeline: per-cell top-k -> cross-shard
         merge -> vote + report ordering, all query-sharded on device (the
         sharded analog of single._full_blocks)."""
-        key = ("full", k, data_block, select, num_labels, impl)
+        key = ("full", k, data_block, select, num_labels, impl, precision)
         if key not in self._fns:
             merge = self._merge_strategy
-            solve_shard = self._solve_shard_fn(k, data_block, select, impl)
+            solve_shard = self._solve_shard_fn(k, data_block, select, impl,
+                                               precision)
 
             def local(data_a, data_l, data_i, q_attrs, ks):
                 from dmlp_tpu.ops.vote import majority_vote, report_order

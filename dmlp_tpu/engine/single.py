@@ -30,7 +30,8 @@ import numpy as np
 
 from dmlp_tpu.config import EngineConfig
 from dmlp_tpu.engine.finalize import (boundary_hazard, finalize_host,
-                                      repair_boundary_overflow, staging_eps)
+                                      lowp_eps, repair_boundary_overflow,
+                                      staging_eps)
 from dmlp_tpu.io.grammar import KNNInput, subset_queries
 from dmlp_tpu.io.report import QueryResult
 from dmlp_tpu.obs import counters as obs_counters
@@ -170,7 +171,8 @@ def fit_blocks(n: int, target_block: int, granule: int = 8) -> int:
 
 
 def resolve_kcap(cfg: EngineConfig, kmax: int, select: str, cap: int,
-                 staging: str = "float32") -> int:
+                 staging: str = "float32",
+                 precision: str | None = None) -> int:
     """Device candidate-list width: kmax + margin, rounded to 8, clamped to
     [kmax, cap]. The fast selection paths get >= 8 slack beyond kmax even
     with margin 0: the tie-overflow detector compares the k-th and last
@@ -185,10 +187,23 @@ def resolve_kcap(cfg: EngineConfig, kmax: int, select: str, cap: int,
     shape: a 32-slot window leaves 3453/10000 queries flagged, 64 slots
     71, 96 slots 0 — the constant is that measurement plus headroom; the
     (vectorized-oracle) repair stays as the sound backstop for inputs
-    whose distance density outruns it."""
+    whose distance density outruns it.
+
+    ``precision`` is the first-pass dot precision the window must clear
+    (config.resolve_precision when None — the inflation is planned from
+    the CONFIGURED precision, not the active rung: a bf16-sized window
+    fed by an f32 pass is merely generous, never unsound, and planning
+    it once keeps the window static across ladder steps). "bf16" reuses
+    the bf16-staging depth (96 + k/2): the cast perturbs every distance
+    by at most finalize.lowp_eps, the same coef * (qn + dn_max) shape
+    as the staging cancellation term that margin was calibrated for."""
+    if precision is None:
+        precision = cfg.resolve_precision()
     extra = cfg.margin if cfg.exact else 0
     if select in ("sort", "topk", "seg", "extract"):
         extra = max(extra, 8)
+    if precision == "bf16" and cfg.exact:
+        extra = max(extra, 96 + kmax // 2)
     if staging == "bfloat16" and cfg.exact:
         extra = max(extra, 96 + kmax // 2)
     elif cfg.exact:
@@ -346,6 +361,39 @@ def staging_for_k(engine, kmax: int):
     return contextlib.nullcontext()
 
 
+def active_precision(engine) -> str:
+    """First-pass dot precision THIS dispatch actually runs at.
+
+    "bf16" only when all three hold: the config resolves to it
+    (config.resolve_precision — ``$DMLP_TPU_PRECISION`` included), the
+    solve is exact (the f64 rescore + boundary repair are the backstop
+    that makes a lossy first pass sound; fast ordering has none), and
+    the resilience ladder still sits on its top "lowp" rung — the first
+    OOM step-down gives the low-precision pass (and, on the next plan,
+    its inflated window) back before anything else. Resolved OUTSIDE
+    every jit and passed as a static argument, so every compiled
+    program keys on the result (R2 discipline). Candidate windows
+    deliberately do NOT consult this: resolve_kcap plans from the
+    CONFIGURED precision so the window stays static across rungs.
+
+    Engines that freeze a precision PLAN at construction (the resident
+    serving engines — their bucket kcaps and staged summary-eps
+    constants derive from it) expose ``_precision_plan``; the active
+    cast clamps to it, so flipping ``$DMLP_TPU_PRECISION`` to "bf16"
+    under a server whose windows were planned f32 cannot run a lossy
+    pass against uninflated windows. (The f32 flip under a bf16 plan
+    is always safe: wider-than-needed windows only.)"""
+    if getattr(engine, "_degrade_rung", "fused") != "lowp":
+        return "f32"
+    cfg = engine.config
+    if not cfg.exact:
+        return "f32"
+    plan = getattr(engine, "_precision_plan", None)
+    if plan is not None and plan != "bf16":
+        return "f32"
+    return cfg.resolve_precision()
+
+
 @functools.partial(jax.jit,
                    static_argnames=("chunk_rows", "k", "select", "use_pallas"))
 def _outlier_fold(carry: TopK, q_attrs, battrs, labels_all, lo, n_real, *,
@@ -403,21 +451,25 @@ def _extract_finalize(od, oi, glabels, *, k):
     return select_topk(od, labels, oi, k)
 
 
-@functools.partial(jax.jit, static_argnames=("staging", "na"))
-def _mp_floor(od, qn, dn_max, *, staging: str, na: int):
+@functools.partial(jax.jit, static_argnames=("staging", "na", "precision"))
+def _mp_floor(od, qn, dn_max, *, staging: str, na: int,
+              precision: str = "f32"):
     """Next-pass floor, computed ON DEVICE so passes chain without a host
     readback (an inter-pass sync costs a full tunnel round trip per pass,
     measured ~1.3 s of serialization at 9 passes). Ports
     finalize.staging_eps: floor = max(od) - eps(max(od)); exhausted rows
     (max = inf) get floor = +inf so later passes yield empty lists.
+    A "bf16" first pass deepens the eps by the finalize.lowp_eps term
+    (the floor must clear the cast error too, or a later pass could
+    skip a candidate the low-precision dot pushed below the boundary).
     Returns (floor (Q, 1) f32, fd (Q,) f32 for post-hoc stall checks)."""
     from dmlp_tpu.engine.finalize import (EPS_CANCEL_COEF, EPS_REL_BF16,
-                                          EPS_REL_F32)
+                                          EPS_REL_F32, LOWP_COEF)
     fd = jnp.max(od, axis=1)
     rel = EPS_REL_BF16 if staging == "bfloat16" else EPS_REL_F32
     scale = qn + dn_max
     eps = (rel * jnp.sqrt(jnp.maximum(fd, 0.0) * scale)
-           + EPS_CANCEL_COEF * (na + 2) * scale)
+           + (EPS_CANCEL_COEF * (na + 2) + LOWP_COEF[precision]) * scale)
     floor = jnp.where(jnp.isfinite(fd), fd - eps, jnp.inf)
     return floor[:, None].astype(jnp.float32), fd
 
@@ -505,6 +557,10 @@ class SingleChipEngine:
         # Analytic peak-HBM model of the last solve (obs.memwatch);
         # populated only while a telemetry session is active.
         self.last_mem_model = None
+        # Low-precision first-pass record of the last run(): active/
+        # configured precision + the window slots the bound inflation
+        # added (bench A/B and the CLI metrics summary read it).
+        self.last_precision = None
 
     def _staging_itemsize(self) -> int:
         return 2 if self._staging == "bfloat16" else 4
@@ -512,18 +568,21 @@ class SingleChipEngine:
     def _plan_prune(self, inp: KNNInput, nchunks: int, chunk_rows: int):
         """Stage 0+1 of the pruned two-stage solve for a chunked
         driver: (survivor chunk schedule, plan stats | None). Active
-        only on the resilience ladder's top ``prune`` rung (run()
-        enters it; candidates()/run_device_full stay dense — fast
-        ordering has no repair backstop), in exact mode, with the
-        ``DMLP_TPU_PRUNE`` kill switch on, and when there is more than
-        one block to choose between. The schedule preserves natural
-        chunk order, so ChunkThrottle backpressure and the affine-id
-        contract are untouched — pruned blocks are simply never
-        staged."""
+        only on the resilience ladder's top ``lowp``/``prune`` rungs
+        (run() enters at "lowp"; candidates()/run_device_full stay
+        dense — fast ordering has no repair backstop), in exact mode,
+        with the ``DMLP_TPU_PRUNE`` kill switch on, and when there is
+        more than one block to choose between. On the "lowp" rung with
+        precision resolving to "bf16" the prune thresholds widen by
+        the finalize.lowp_eps cast bound — a block must stay pruned
+        under the error the low-precision first pass could add. The
+        schedule preserves natural chunk order, so ChunkThrottle
+        backpressure and the affine-id contract are untouched — pruned
+        blocks are simply never staged."""
         n = inp.params.num_data
         dense = list(range(nchunks))
         if (nchunks <= 1 or n == 0 or inp.params.num_queries == 0
-                or self._degrade_rung != "prune"
+                or self._degrade_rung not in ("lowp", "prune")
                 or not self.config.exact):
             return dense, None
         from dmlp_tpu.ops import summaries as osum
@@ -534,7 +593,8 @@ class SingleChipEngine:
         with obs_span("single.prune_score", blocks=nchunks):
             summ = osum.build_summaries(inp.data_attrs, ranges)
             keep, stats = osum.prune_mask(inp.query_attrs, inp.ks, summ,
-                                          staging=self._staging)
+                                          staging=self._staging,
+                                          precision=active_precision(self))
         schedule = [c for c in dense if keep[c]]
         if not schedule:       # belt: prune_mask guarantees a survivor
             return dense, None
@@ -734,6 +794,7 @@ class SingleChipEngine:
         if kern is None:
             return None
         interpret = not native_pallas_backend()
+        prec = active_precision(self)
         self._last_select = "extract"
         self.last_extract_impl = impl
 
@@ -765,12 +826,13 @@ class SingleChipEngine:
                     # Resolved via the analytic kernel model
                     # (obs.kernel_cost) — pallas_call has no XLA cost.
                     obs_counters.record_dispatch(
-                        kern, (q_dev, da), statics=dict(kc=k),
+                        kern, (q_dev, da), statics=dict(kc=k,
+                                                        precision=prec),
                         count=len(live),
                         site="single.extract_topk")
                 od, oi, _iters = kern(
                     q_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=k,
-                    interpret=interpret)
+                    interpret=interpret, precision=prec)
                 mi.add(_iters)
                 throttle.tick(od)
                 telemetry.sample_memory_now()   # staging window live
@@ -884,6 +946,7 @@ class SingleChipEngine:
                 "supports() invariants diverged between the chunked "
                 "pass 1 and the resident passes 2+")
         interpret = not native_pallas_backend()
+        prec = active_precision(self)
         self._last_select = "extract"
         self.last_extract_impl = impl
         rs_inject.fire("single.extract_solve", rung=self._degrade_rung,
@@ -911,12 +974,12 @@ class SingleChipEngine:
             da = stage_put(a, self._staging)
             if c == 0:
                 obs_counters.record_dispatch(
-                    kern, (q_dev, da), statics=dict(kc=kc),
+                    kern, (q_dev, da), statics=dict(kc=kc, precision=prec),
                     count=n_staged, site="single.extract_mp_pass1")
             chunks.append((da, lo, hi))
             od, oi, _iters = kern(q_dev, da, od, oi, n_real=hi - lo,
                                   id_base=lo, kc=kc,
-                                  interpret=interpret)
+                                  interpret=interpret, precision=prec)
             mi.add(_iters)
             throttle.tick(od)
         mi.done()
@@ -952,18 +1015,20 @@ class SingleChipEngine:
         # otherwise the dataset is HBM-resident TWICE for the whole sweep
         if npasses > 1:
             obs_counters.record_dispatch(
-                kern_full, (q_dev, d_full), statics=dict(kc=kc),
+                kern_full, (q_dev, d_full),
+                statics=dict(kc=kc, precision=prec),
                 count=npasses - 1, site="single.extract_mp_resident")
         fds = []
         mir = MeasuredIters(self, "single.extract_mp_resident",
                             (qpad, full_rows, na, kc), kernel=impl_full)
         for _p in range(1, npasses):
             floor_dev, fd = _mp_floor(ods[-1], qn_dev, dn_dev,
-                                      staging=self._staging, na=na)
+                                      staging=self._staging, na=na,
+                                      precision=prec)
             fds.append(fd)
             od, oi, _iters = kern_full(q_dev, d_full, n_real=n, id_base=0,
                                        kc=kc, interpret=interpret,
-                                       floor=floor_dev)
+                                       floor=floor_dev, precision=prec)
             mir.add(_iters)
             throttle.tick(od)
             ods.append(od)
@@ -973,7 +1038,8 @@ class SingleChipEngine:
         # flag as well (its ties are the one loss the outer boundary test
         # can miss when kcap >= n).
         fds.append(_mp_floor(ods[-1], qn_dev, dn_dev,
-                             staging=self._staging, na=na)[1])
+                             staging=self._staging, na=na,
+                             precision=prec)[1])
         self.last_phase_ms["enqueue"] = (_time.perf_counter() - t0) * 1e3
         self.last_mp_passes = len(ods)
 
@@ -1066,6 +1132,7 @@ class SingleChipEngine:
         ko = resolve_kcap(cfg, int(inp.ks[outl].max()), select_out,
                           nchunks * chunk_rows, staging=self._staging)
         interpret = not native_pallas_backend()
+        prec = active_precision(self)
         self._last_select = "extract"
         self.last_extract_impl = impl
         self.last_hetk = (int(bulk.size), int(outl.size))
@@ -1104,12 +1171,13 @@ class SingleChipEngine:
             scanned += (hi - lo) * na * self._staging_itemsize()
             if c == live_sched[0]:
                 obs_counters.record_dispatch(
-                    kern, (qb_dev, da), statics=dict(kc=kb),
+                    kern, (qb_dev, da), statics=dict(kc=kb,
+                                                     precision=prec),
                     count=len(live_sched),
                     site="single.extract_bulk")
             od, oi, _iters = kern(
                 qb_dev, da, od, oi, n_real=hi - lo, id_base=lo, kc=kb,
-                interpret=interpret)
+                interpret=interpret, precision=prec)
             mi.add(_iters)
             carry_o = _outlier_fold(
                 carry_o, qo_dev, da, labels_dev,
@@ -1215,6 +1283,21 @@ class SingleChipEngine:
         # staged chunks/carries are live, nothing is fetched yet (no-op
         # without a telemetry session).
         telemetry.sample_memory_now()
+        # Precision record for metrics/bench: what the first pass ran
+        # at, and how many window slots the bound inflation bought the
+        # rescore (kcap minus what an f32-precision plan would have
+        # sized — 0 whenever precision resolves to "f32").
+        prec = active_precision(self)
+        kcap0 = int(segments[0][0].dists.shape[1])
+        kmax0 = int(inp.ks.max()) if inp.params.num_queries else 0
+        self.last_precision = {
+            "active": prec,
+            "configured": self.config.resolve_precision(),
+            "kcap": kcap0,
+            "kcap_inflation": kcap0 - resolve_kcap(
+                self.config, kmax0, self._last_select, kcap0,
+                staging=self._staging, precision="f32"),
+        }
         self.last_repairs = 0  # tie-overflow repair rate, for bench records
         self.last_comms = []   # one chip: no collectives (obs.comms)
         merged: List[QueryResult] = [None] * inp.params.num_queries
@@ -1258,6 +1341,13 @@ class SingleChipEngine:
                 qn = np.einsum("qa,qa->q", sub.query_attrs, sub.query_attrs)
                 eps = staging_eps(last, qn, dn_max, self._staging,
                                   inp.params.num_attrs)
+                if prec == "bf16" and select == "extract":
+                    # The low-precision first pass perturbs device
+                    # distances by up to lowp_eps ON TOP of the staging
+                    # rounding; the hazard test must clear both.
+                    # Streaming-fallback segments never cast, so their
+                    # eps stays the staging bound alone.
+                    eps = eps + lowp_eps("bf16", qn, dn_max)
                 flags = boundary_hazard(kth, last, eps)
             # Multi-pass extraction's own loss detectors (stall/shortfall,
             # _solve_extract_multipass) join the standard boundary test.

@@ -54,7 +54,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dmlp_tpu.config import EngineConfig
 from dmlp_tpu.engine.finalize import (boundary_overflow, finalize_host,
-                                      repair_boundary_overflow, staging_eps)
+                                      lowp_eps, repair_boundary_overflow,
+                                      staging_eps)
 from dmlp_tpu.engine.sharded import ShardedEngine, _np_staging_dtype
 from dmlp_tpu.engine.single import (_BF16_AUTO_K_CAP, ChunkThrottle,
                                     MeasuredIters, flush_measured_iters,
@@ -130,6 +131,13 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         if cap < n:
             raise ValueError(f"capacity {cap} < corpus rows {n}")
         self.num_attrs = na
+        # First-pass precision PLAN, frozen at construction like the
+        # single-chip resident engine's: bucket kcaps and the active
+        # cast both derive from it (_active_prec clamps the per-batch
+        # resolve to the plan), so an env flip mid-serve can disable
+        # the bf16 pass but never run it against f32-planned windows.
+        self._precision_plan = cfg.resolve_precision()
+        self.last_precision = None
         # Cross-request fused-gate warm-up, mesh edition (ROADMAP
         # follow-on (e)): the single-chip hot-block histogram doesn't
         # port 1:1 — here heat is tracked PER (shard, chunk), and the
@@ -318,7 +326,8 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
                       **self._rid_args()):
             keep, stats = osum.prune_mask(inp.query_attrs, inp.ks,
                                           self._summ,
-                                          staging=self._staging)
+                                          staging=self._staging,
+                                          precision=self._active_prec())
         self.last_prune_fraction = stats["pruned_fraction"]
         return keep.reshape(r, self._nchunks), stats
 
@@ -349,14 +358,24 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         admission pricing and the memwatch model share with the solve."""
         qpad, kb = self.bucket_shape(nq, kmax)
         kcap = resolve_kcap(self.config, kb, "extract",
-                            self.capacity_rows, staging=self._staging)
+                            self.capacity_rows, staging=self._staging,
+                            precision=self._precision_plan)
         return qpad, kb, kcap
+
+    def _active_prec(self) -> str:
+        """Per-batch active first-pass precision: the config resolve
+        (env kill switch included, read per call) clamped to the
+        construction-time plan. No resilience ladder here — the mesh
+        engines solve without run_ladder — so there is no rung gate."""
+        prec = self.config.resolve_precision()
+        return prec if prec == self._precision_plan == "bf16" else "f32"
 
     def _build_bucket(self, qpad: int, kb: int) -> _MeshBucket:
         _r, c = self.mesh.devices.shape
         qloc = qpad // c
         kcap = resolve_kcap(self.config, kb, "extract",
-                            self.capacity_rows, staging=self._staging)
+                            self.capacity_rows, staging=self._staging,
+                            precision=self._precision_plan)
         path = "stream"
         if self._extract_ok and kcap <= 512:
             from dmlp_tpu.ops import pallas_fused
@@ -397,10 +416,11 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         k, cr = entry.kcap, self._chunk_rows
         impl = self._extract_impl("extract", entry.qloc, cr,
                                   self.num_attrs, k)
+        prec = self._active_prec()  # resolved outside the jits (R2)
         q_dev = self._stage_queries(inp, entry.qpad)
         keep_m, prune_stats = self._prune_live(inp)
         cd, ci = self._chunk_init_fn(r, entry.qpad, k)()
-        step = self._chunk_fold_fn(k, self._interpret, impl)
+        step = self._chunk_fold_fn(k, self._interpret, impl, prec)
         item = np.dtype(self._np_dtype()).itemsize
         # Pre-walk the fold schedule so the one-time dispatch record
         # can claim the count that will ACTUALLY dispatch — claiming
@@ -561,6 +581,9 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         self.last_prune = None
         self.last_prune_fraction = None
         self._pending_gate = None
+        prec = self._active_prec()
+        self.last_precision = {"active": prec,
+                               "configured": self._precision_plan}
         memwatch.note_engine_model(self, inp)
         entry = self._bucket_entry(nq, kmax)
         if entry.path == "extract":
@@ -592,6 +615,11 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
                 eps = staging_eps(
                     np.asarray(dists[:, -1], np.float64), qn, dn_max,
                     self._staging, self.num_attrs)
+                if prec == "bf16" and self._last_select == "extract":
+                    # The bf16 first pass perturbs device distances
+                    # beyond the staging model — widen the hazard test
+                    # by its analytic bound (finalize.lowp_eps).
+                    eps = eps + lowp_eps("bf16", qn, dn_max)
                 suspects = np.nonzero(
                     boundary_overflow(dists, inp.ks, eps))[0]
                 if suspects.size:
@@ -730,6 +758,9 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
             "summary_rebuilds": self.summary_rebuilds,
             "last_prune_fraction": self.last_prune_fraction,
             "last_prune": dict(lp) if isinstance(lp, dict) else None,
+            "precision_plan": self._precision_plan,
+            "last_precision": dict(self.last_precision)
+            if isinstance(self.last_precision, dict) else None,
             "mesh": [r, c],
             "merge": self._merge_strategy,
             "shard_rows": self._shard_rows,

@@ -46,7 +46,18 @@ from typing import Dict, Optional
 
 __all__ = ["extract_topk_cost", "extract_loop_cost", "fused_topk_cost",
            "two_pass_equivalent_cost", "fused_dist_segmin_cost",
-           "summaries_score_cost", "analytic_cost"]
+           "summaries_score_cost", "analytic_cost", "MXU_PASSES"]
+
+#: MXU hardware passes per dot tile by first-pass precision: the MXU
+#: multiplies in bf16, so an f32 dot at HIGHEST preferred precision
+#: decomposes into ~3 bf16 product passes (the bf16x3 scheme), while a
+#: "bf16" first pass (ops.pallas_* ``precision="bf16"``, f32
+#: accumulation) issues ONE. The ``flops`` fields below deliberately do
+#: NOT scale by this — they keep XLA's dot convention (2*Q*B*A
+#: regardless of precision) so flops stay comparable across arms and
+#: history; the pass count is reported alongside as ``mxu_passes`` /
+#: ``mxu_precision`` for roofline math that wants hardware-issue terms.
+MXU_PASSES = {"f32": 3, "bf16": 1}
 
 
 def _variant_resolver(kernel: str):
@@ -62,7 +73,8 @@ def _variant_resolver(kernel: str):
 
 
 def extract_loop_cost(qb: int, b: int, a: int, kc: int,
-                      iters_total: int, kernel: str = "extract") -> float:
+                      iters_total: int, kernel: str = "extract",
+                      precision: str = "f32") -> float:
     """MEASURED extraction-loop FLOPs for ``iters_total`` recorded loop
     iterations (summed over the kernel's (Qb/tq, B/tn) ``iters`` output,
     possibly across many dispatches at the same shape).
@@ -77,11 +89,13 @@ def extract_loop_cost(qb: int, b: int, a: int, kc: int,
     scales with it), so it must match the dispatch. ``kernel``
     ("extract" | "fused") selects WHICH tune-cache namespace the tiles
     resolve through — the fused megakernel may run different tiles, so
-    its measured iterations must be costed at its own resolution."""
+    its measured iterations must be costed at its own resolution (and
+    ``precision`` keys the same resolution — per-precision winners may
+    pin different tiles)."""
     from dmlp_tpu.ops.pallas_distance import _tile
     from dmlp_tpu.ops.pallas_extract import _TN
 
-    v = _variant_resolver(kernel)(kc, b, qb, a)
+    v = _variant_resolver(kernel)(kc, b, qb, a, precision)
     tq = _tile(qb, v["tile_q"], 8)
     tn = _tile(b, v.get("tile_n", _TN), 128 * v["ne"])
     round_flops = 5.0 * tq * tn + 4.0 * v["ne"] * tq * kc
@@ -89,17 +103,21 @@ def extract_loop_cost(qb: int, b: int, a: int, kc: int,
 
 
 def _streaming_cost(qb: int, b: int, a: int, kc: int,
-                    kernel: str = "extract") -> Dict[str, float]:
+                    kernel: str = "extract",
+                    precision: str = "f32") -> Dict[str, float]:
     """The SHARED deterministic model of one streaming top-k dispatch
     (the (qb, b) distance tile lives only in VMEM): flops + HBM bytes
     at the tiles the ``kernel`` namespace ("extract" | "fused")
     resolves for this shape. One body for both kernels — the fused
     megakernel adds only its gate term on top — so a future fix to any
-    shared term cannot drift between the two models."""
+    shared term cannot drift between the two models. ``precision``
+    keys the variant resolution (per-precision winners) but does NOT
+    change the modeled flops/bytes — operands stream at their staged
+    width either way and the in-VMEM cast is free of HBM traffic."""
     from dmlp_tpu.ops.pallas_distance import _tile
     from dmlp_tpu.ops.pallas_extract import _TN
 
-    v = _variant_resolver(kernel)(kc, b, qb, a)
+    v = _variant_resolver(kernel)(kc, b, qb, a, precision)
     tq = _tile(qb, v["tile_q"], 8)
     tn = _tile(b, v.get("tile_n", _TN), 128 * v["ne"])
     flops = (2.0 * qb * b * a      # MXU cross-term block
@@ -117,24 +135,32 @@ def _streaming_cost(qb: int, b: int, a: int, kc: int,
 
 
 def extract_topk_cost(qb: int, b: int, a: int, kc: int,
-                      iters_total: Optional[int] = None) -> Dict[str, float]:
+                      iters_total: Optional[int] = None,
+                      precision: str = "f32") -> Dict[str, float]:
     """Cost of one ``ops.pallas_extract.extract_topk`` dispatch at
     (queries (qb, a), data (b, a), list width kc). Without
     ``iters_total`` the data-dependent while-loop is excluded
     (deterministic lower bound); with it, the measured extraction term
-    (:func:`extract_loop_cost`) is added and the dict says so."""
-    base = _streaming_cost(qb, b, a, kc)
+    (:func:`extract_loop_cost`) is added and the dict says so.
+    ``precision`` ("f32" | "bf16") keys the tile resolution and is
+    reported back with its MXU pass count (:data:`MXU_PASSES`) —
+    ``flops`` itself keeps the precision-independent dot convention."""
+    base = _streaming_cost(qb, b, a, kc, precision=precision)
     out = {"flops": base["flops"], "bytes_accessed": base["bytes_accessed"],
-           "extraction_term": "modeled_lower_bound"}
+           "extraction_term": "modeled_lower_bound",
+           "mxu_precision": precision,
+           "mxu_passes": MXU_PASSES.get(precision, 3)}
     if iters_total is not None:
-        out["flops"] += extract_loop_cost(qb, b, a, kc, iters_total)
+        out["flops"] += extract_loop_cost(qb, b, a, kc, iters_total,
+                                          precision=precision)
         out["extraction_term"] = "measured"
         out["extract_iters_total"] = int(iters_total)
     return out
 
 
 def fused_topk_cost(qb: int, b: int, a: int, kc: int,
-                    iters_total: Optional[int] = None) -> Dict[str, float]:
+                    iters_total: Optional[int] = None,
+                    precision: str = "f32") -> Dict[str, float]:
     """Cost of one ``ops.pallas_fused.fused_topk`` dispatch — the fused
     distance→top-k streaming megakernel. Same one-pass HBM structure as
     :func:`extract_topk_cost` (the (qb, b) distance tile lives only in
@@ -152,8 +178,12 @@ def fused_topk_cost(qb: int, b: int, a: int, kc: int,
     that delta resolve through the SAME (fused) tile namespace, so the
     saved bytes are EXACTLY the 2·4·qb·b distance round-trip — a cached
     fused variant with different tiles than the extract namespace
-    cannot leak tile-resolution differences into the metric."""
-    base = _streaming_cost(qb, b, a, kc, kernel="fused")
+    cannot leak tile-resolution differences into the metric.
+    ``precision`` keys the tile resolution (both sides of the delta)
+    and reports its MXU pass count; ``flops`` stays convention-stable.
+    """
+    base = _streaming_cost(qb, b, a, kc, kernel="fused",
+                           precision=precision)
     tq, tn = base["tq"], base["tn"]
     flops = (base["flops"]
              # The MXU gate itself, per (tq, tn) grid cell: ~3 block
@@ -163,24 +193,28 @@ def fused_topk_cost(qb: int, b: int, a: int, kc: int,
              # blocks skip the matmul entirely.)
              + (qb // tq) * (b // tn) * (3.0 * tn + 8.0 * tq))
     byts = base["bytes_accessed"]
-    tp = two_pass_equivalent_cost(qb, b, a, kc)
+    tp = two_pass_equivalent_cost(qb, b, a, kc, precision=precision)
     out: Dict[str, float] = {
         "flops": flops, "bytes_accessed": byts,
         "extraction_term": "modeled_lower_bound",
+        "mxu_precision": precision,
+        "mxu_passes": MXU_PASSES.get(precision, 3),
         "hbm_bytes_two_pass_equiv": tp["bytes_accessed"],
         "hbm_bytes_saved_vs_two_pass": tp["bytes_accessed"] - byts,
         "hbm_traffic_reduction_x": round(tp["bytes_accessed"] / byts, 2),
     }
     if iters_total is not None:
         out["flops"] += extract_loop_cost(qb, b, a, kc, iters_total,
-                                          kernel="fused")
+                                          kernel="fused",
+                                          precision=precision)
         out["extraction_term"] = "measured"
         out["extract_iters_total"] = int(iters_total)
     return out
 
 
 def two_pass_equivalent_cost(qb: int, b: int, a: int, kc: int,
-                             kernel: str = "fused") -> Dict[str, float]:
+                             kernel: str = "fused",
+                             precision: str = "f32") -> Dict[str, float]:
     """What the SAME dispatch costs when the (qb, b) distance matrix
     round-trips HBM between a distance kernel and a selection pass —
     the pre-fused hot path's two passes over its dominant term:
@@ -188,8 +222,10 @@ def two_pass_equivalent_cost(qb: int, b: int, a: int, kc: int,
     and one full re-read of the f32 distance tile. ``kernel`` picks the
     tile namespace of the streaming base; it defaults to "fused" so the
     fused model's ``hbm_bytes_saved_vs_two_pass`` is exactly the
-    round-trip delta by construction (same tiles on both sides)."""
-    base = _streaming_cost(qb, b, a, kc, kernel=kernel)
+    round-trip delta by construction (same tiles on both sides), and
+    ``precision`` keys that shared resolution too."""
+    base = _streaming_cost(qb, b, a, kc, kernel=kernel,
+                           precision=precision)
     return {"flops": base["flops"],
             "bytes_accessed": base["bytes_accessed"]
             + 4.0 * 2.0 * qb * b}
@@ -245,7 +281,9 @@ def _extract_entry(specs, statics) -> Optional[Dict[str, float]]:
         kc = int(statics["kc"])
     except Exception:
         return None
-    return extract_topk_cost(qb, b, a, kc)
+    return extract_topk_cost(qb, b, a, kc,
+                             precision=str(statics.get("precision",
+                                                       "f32")))
 
 
 def _fused_entry(specs, statics) -> Optional[Dict[str, float]]:
@@ -256,7 +294,9 @@ def _fused_entry(specs, statics) -> Optional[Dict[str, float]]:
         kc = int(statics["kc"])
     except Exception:
         return None
-    return fused_topk_cost(qb, b, a, kc)
+    return fused_topk_cost(qb, b, a, kc,
+                           precision=str(statics.get("precision",
+                                                     "f32")))
 
 
 def _segmin_entry(specs, statics) -> Optional[Dict[str, float]]:

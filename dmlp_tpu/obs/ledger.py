@@ -283,6 +283,14 @@ def _runrecord_series_name(rec: RunRecord, key: str) -> str:
         # round-comparable (gated by tools/perf_gate.py).
         cfg_tag = f"/config{cid}" if cid is not None else ""
         return f"prune{cfg_tag}/{key}"
+    if rec.kind == "precision":
+        # bf16-vs-f32 first-pass A/B records (bench --precision-ab,
+        # tools/precision_smoke.py): one ``precision/`` family
+        # regardless of emitter so the per-arm engine times and the
+        # window-inflation meters stay round-comparable (gated by
+        # tools/perf_gate.py).
+        cfg_tag = f"/config{cid}" if cid is not None else ""
+        return f"precision{cfg_tag}/{key}"
     if rec.tool == "dmlp_tpu.bench" and cid is not None:
         return f"harness/config{cid}/{key}"
     if rec.kind == "telemetry":

@@ -61,11 +61,21 @@ def supports(qb: int, b: int, a: int) -> bool:
     return 2 * blocks_bytes <= 12 * 2**20  # double-buffered
 
 
-def _kernel(q_ref, d_ref, qn_ref, dn_ref, ids_ref, dist_ref, segmin_ref):
+def _kernel(q_ref, d_ref, qn_ref, dn_ref, ids_ref, dist_ref, segmin_ref,
+            *, precision: str = "f32"):
     # HIGHEST precision: default truncates f32 to bf16 on the MXU (1e-2
     # relative distance error measured on v5e — breaks neighbor selection).
+    # A "bf16" FIRST PASS casts the operands instead (one MXU pass, f32
+    # accumulation kept): every emitted distance then errs by at most
+    # engine.finalize.lowp_eps, which the caller must fold into any
+    # window/threshold decision fed by this tile.
+    q = q_ref[:]
+    d = d_ref[:]
+    if precision == "bf16":
+        q = q.astype(jnp.bfloat16)  # check: lowp-eps=lowp_eps
+        d = d.astype(jnp.bfloat16)  # check: lowp-eps=lowp_eps
     cross = jax.lax.dot_general(
-        q_ref[:], d_ref[:], (((1,), (1,)), ((), ())),
+        q, d, (((1,), (1,)), ((), ())),
         precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32)
     dist = qn_ref[:] + dn_ref[:] - 2.0 * cross
@@ -79,14 +89,20 @@ def _kernel(q_ref, d_ref, qn_ref, dn_ref, ids_ref, dist_ref, segmin_ref):
     segmin_ref[:] = dist.reshape(tq, tn // SEG, SEG).min(axis=-1).T
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "precision"))
 def fused_dist_segmin(q_attrs: jax.Array, d_attrs: jax.Array,
-                      data_ids: jax.Array, interpret: bool = False):
+                      data_ids: jax.Array, interpret: bool = False,
+                      precision: str = "f32"):
     """(queries (Qb, A), data (B, A), ids (B,)) -> (dist (Qb, B) f32,
     segmin (Qb, B/SEG) f32). Sentinel columns (id < 0) give +inf.
 
     Qb must divide by 8 and B by SEG; A is unconstrained (one MXU pass).
+    ``precision`` ("f32" | "bf16", static — resolve OUTSIDE any jit)
+    picks the first-pass dot dtype; bf16 distances carry the
+    engine.finalize.lowp_eps bound.
     """
+    if precision not in ("f32", "bf16"):
+        raise ValueError(f"unsupported first-pass precision {precision!r}")
     qb, a = q_attrs.shape
     b = d_attrs.shape[0]
     if not supports(qb, b, a):
@@ -104,7 +120,7 @@ def fused_dist_segmin(q_attrs: jax.Array, d_attrs: jax.Array,
 
     grid = (qb // tq, b // tn)
     dist, segmin_t = pl.pallas_call(
-        _kernel,
+        functools.partial(_kernel, precision=precision),
         grid=grid,
         in_specs=[
             pl.BlockSpec((tq, a), lambda i, j: (i, 0)),

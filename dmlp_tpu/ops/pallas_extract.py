@@ -110,21 +110,26 @@ def _heuristic_variant(kc: int, b: int) -> dict:
 
 
 def _resolve_variant(kc: int, b: int, qb: int | None = None,
-                     a: int | None = None) -> dict:
+                     a: int | None = None,
+                     precision: str = "f32") -> dict:
     """The variant actually used for (kc, b): the measured autotuner
     cache entry when one exists for this (device kind, bucket(b),
-    bucket(a), kc) (dmlp_tpu.tune.lookup_variant — never raises, and
-    rejects entries whose ne-alignment cannot tile this b), else the
-    deterministic heuristic. When the caller knows the full dispatch
-    shape (qb, a), a cached variant must ALSO pass variant_supports
-    (VMEM bound included) or resolution falls back — a cache entry may
-    downgrade resolution to the heuristic but can never flip supports()
-    False and disable the kernel. supports(), extract_topk, and the
-    analytic cost model (obs.kernel_cost) resolve through this same
-    function with the same shape arguments, so gate, kernel and
-    counters can never disagree."""
+    bucket(a), kc, precision) (dmlp_tpu.tune.lookup_variant — never
+    raises, and rejects entries whose ne-alignment cannot tile this b),
+    else the deterministic heuristic. ``precision`` is a cache key
+    axis, never a tiling constraint: a bf16 first pass spends one MXU
+    pass per tile where f32 spends ~3, which moves the winning tile
+    but not what CAN tile, so the heuristic fallback is shared. When
+    the caller knows the full dispatch shape (qb, a), a cached variant
+    must ALSO pass variant_supports (VMEM bound included) or
+    resolution falls back — a cache entry may downgrade resolution to
+    the heuristic but can never flip supports() False and disable the
+    kernel. supports(), extract_topk, and the analytic cost model
+    (obs.kernel_cost) resolve through this same function with the same
+    shape arguments, so gate, kernel and counters can never
+    disagree."""
     from dmlp_tpu.tune import lookup_variant
-    cached = lookup_variant(kc, b, a=a)
+    cached = lookup_variant(kc, b, a=a, precision=precision)
     if cached is not None:
         if qb is None or a is None \
                 or variant_supports(qb, b, a, kc, cached):
@@ -133,13 +138,14 @@ def _resolve_variant(kc: int, b: int, qb: int | None = None,
 
 
 def resolve_variant(kc: int, b: int, qb: int | None = None,
-                    a: int | None = None) -> dict:
+                    a: int | None = None,
+                    precision: str = "f32") -> dict:
     """Public form of the variant resolution (engines/bench/tools report
     it in spans and artifacts): the dict extract_topk will run with —
     always carries tile_q/ne/unroll, plus tile_n when the tuner cache
     pinned one. Pass the full (qb, a) dispatch shape where known so the
     reported variant matches the kernel's own resolution exactly."""
-    return dict(_resolve_variant(kc, b, qb, a))
+    return dict(_resolve_variant(kc, b, qb, a, precision))
 
 
 def variant_supports(qb: int, b: int, a: int, kc: int, v: dict) -> bool:
@@ -167,10 +173,29 @@ def supports(qb: int, b: int, a: int, kc: int) -> bool:
     return variant_supports(qb, b, a, kc, _resolve_variant(kc, b, qb, a))
 
 
+def _dot_cross(q, d, precision: str):
+    """The (tq, tn) cross-term block at the requested FIRST-PASS
+    precision. "f32": HIGHEST-precision f32 dot (the default would
+    truncate f32 to bf16 on the MXU — 1e-2 relative distance error
+    measured on v5e, breaks neighbor selection; HIGHEST decomposes into
+    ~3 bf16 passes instead). "bf16": ONE MXU pass on bf16-cast operands
+    with f32 accumulation kept — the cast's distance perturbation is
+    bounded by engine.finalize.lowp_eps, which every caller folds into
+    its candidate window, prune threshold, and gate bound so the
+    unchanged f64 rescore + boundary repair restores exact results."""
+    if precision == "bf16":
+        q = q.astype(jnp.bfloat16)  # check: lowp-eps=lowp_eps
+        d = d.astype(jnp.bfloat16)  # check: lowp-eps=lowp_eps
+    return jax.lax.dot_general(
+        q, d, (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+
+
 def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, f_ref, cd_ref, ci_ref,
             od_ref, oi_ref, it_ref, dist_s, *, kc: int, fresh: bool, ne: int,
             unroll: int = 1, block_skip: bool = True,
-            mxu_gate: bool = False):
+            mxu_gate: bool = False, precision: str = "f32"):
     j = pl.program_id(1)
     nj = pl.num_programs(1)
     tq, tn = dist_s.shape
@@ -183,13 +208,7 @@ def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, f_ref, cd_ref, ci_ref,
 
     gate_on = None
     if not mxu_gate:
-        # HIGHEST precision: default truncates f32 to bf16 on the MXU (1e-2
-        # relative distance error measured on v5e — breaks neighbor
-        # selection).
-        cross = jax.lax.dot_general(
-            q_ref[:], d_ref[:], (((1,), (1,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32)
+        cross = _dot_cross(q_ref[:], d_ref[:], precision)
         dist = qn_ref[:] + dn_ref[:] - 2.0 * cross
         dist = jnp.maximum(dist, 0.0)
         # Per-row floor (multi-pass extraction, engine.single
@@ -236,7 +255,8 @@ def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, f_ref, cd_ref, ci_ref,
             def _():
                 od_ref[:] = cd_ref[:]
                 oi_ref[:] = ci_ref[:]
-        from dmlp_tpu.engine.finalize import EPS_CANCEL_COEF, EPS_REL_F32
+        from dmlp_tpu.engine.finalize import (EPS_CANCEL_COEF,
+                                              EPS_REL_F32, LOWP_COEF)
         na = q_ref.shape[1]
         lane1 = jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1)
         real = (j * tn + lane1) < n_real
@@ -250,8 +270,14 @@ def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, f_ref, cd_ref, ci_ref,
         gap = jnp.maximum(jnp.maximum(mn - sq, sq - mx), 0.0)
         lb = gap * gap                                     # (tq, 1)
         scale = jnp.maximum(qn, 0.0) + dn_hi
+        # A low-precision pass perturbs the COMPUTED distances the gate
+        # reasons about by up to lowp_eps more than f32 rounding alone,
+        # so the deflation margin widens by LOWP_COEF * scale (the
+        # device form of engine.finalize.lowp_eps — same composition
+        # the host prune/hazard tests apply).
         eps = (EPS_REL_F32 * jnp.sqrt(lb * scale)
-               + EPS_CANCEL_COEF * (na + 2) * scale)
+               + (EPS_CANCEL_COEF * (na + 2)
+                  + LOWP_COEF[precision]) * scale)
         # All-sentinel blocks drive lb (and hence eps) to +inf; the
         # inf - inf NaN compares False below, which IS the correct skip.
         lb_safe = jnp.maximum(lb - eps, 0.0)
@@ -265,10 +291,7 @@ def _kernel(sc_ref, q_ref, d_ref, qn_ref, dn_ref, f_ref, cd_ref, ci_ref,
 
         @pl.when(gate_on)
         def _():
-            cross = jax.lax.dot_general(
-                q_ref[:], d_ref[:], (((1,), (1,)), ((), ())),
-                precision=jax.lax.Precision.HIGHEST,
-                preferred_element_type=jnp.float32)
+            cross = _dot_cross(q_ref[:], d_ref[:], precision)
             dist = qn_ref[:] + dn_ref[:] - 2.0 * cross
             dist = jnp.maximum(dist, 0.0)
             dist = jnp.where(dist < f_ref[:], jnp.inf, dist)
@@ -371,7 +394,7 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
                  tile_q: int | None = None, tile_n: int | None = None,
                  ne: int | None = None, unroll: int | None = None,
                  block_skip: bool = True, mxu_gate: bool = False,
-                 floor: jax.Array | None = None):
+                 floor: jax.Array | None = None, precision: str = "f32"):
     """(queries (Qb, A), data (B, A)) -> (dists (Qb, kc) f32 ascending-ish
     unsorted, ids (Qb, kc) i32, iters (Qb/tq, B/tn) i32 loop counts; 0 =
     the threshold prefilter skipped that block).
@@ -394,13 +417,21 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
     tools/roofline_extract.py). ``mxu_gate`` enables the fused
     megakernel's norm-bound MXU tile gating (output-identical;
     ops.pallas_fused.fused_topk is the public face, which also resolves
-    variants from the fused tune-cache namespace).
+    variants from the fused tune-cache namespace). ``precision``
+    ("f32" | "bf16") selects the FIRST-PASS dot dtype: "bf16" casts the
+    streamed q/d tiles before the MXU (one pass instead of HIGHEST's
+    ~3) with f32 accumulation kept — candidate lists then deviate from
+    the f32 pass by at most engine.finalize.lowp_eps per distance, and
+    callers MUST widen their candidate window / prune / hazard bounds
+    by that margin (resolve_kcap + staging_eps composition do) for the
+    exact pipeline to stay byte-identical. Static: part of the jit
+    cache key, resolved by callers OUTSIDE every jit (R2 discipline).
 
     Gate on supports() first. Output lists are NOT sorted; callers sort by
     the composite key (ops.topk.select_topk) if order matters.
     """
     v = _resolve_variant(kc, d_attrs.shape[0], q_attrs.shape[0],
-                         q_attrs.shape[1])
+                         q_attrs.shape[1], precision)
     # Eager callers pass plain ints for the traced SMEM scalars; under
     # the sanitizer's transfer guard the jit argument conversion would
     # be an implicit host->device transfer — make it explicit here (a
@@ -411,6 +442,9 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
         n_real = jax.device_put(_onp.int32(n_real))
     if isinstance(id_base, (int, _onp.integer)):
         id_base = jax.device_put(_onp.int32(id_base))
+    if precision not in ("f32", "bf16"):
+        raise ValueError(f"unsupported first-pass precision {precision!r} "
+                         "(int8 is the gated follow-on — see ROADMAP)")
     return _extract_topk_jit(
         q_attrs, d_attrs, carry_d, carry_i, n_real=n_real,
         id_base=id_base, kc=kc, interpret=interpret,
@@ -418,16 +452,18 @@ def extract_topk(q_attrs: jax.Array, d_attrs: jax.Array,
         tile_n=v.get("tile_n", _TN) if tile_n is None else tile_n,
         ne=v["ne"] if ne is None else ne,
         unroll=v["unroll"] if unroll is None else unroll,
-        block_skip=block_skip, mxu_gate=mxu_gate, floor=floor)
+        block_skip=block_skip, mxu_gate=mxu_gate, floor=floor,
+        precision=precision)
 
 
 @functools.partial(
     jax.jit, static_argnames=("kc", "interpret", "tile_q", "tile_n", "ne",
-                              "unroll", "block_skip", "mxu_gate"))
+                              "unroll", "block_skip", "mxu_gate",
+                              "precision"))
 def _extract_topk_jit(q_attrs, d_attrs, carry_d, carry_i, *, n_real,
                       id_base, kc: int, interpret: bool, tile_q: int,
                       tile_n: int, ne: int, unroll: int, block_skip: bool,
-                      mxu_gate: bool, floor):
+                      mxu_gate: bool, floor, precision: str = "f32"):
     qb, a = q_attrs.shape
     b = d_attrs.shape[0]
     tq = _tile(qb, tile_q, 8)
@@ -459,7 +495,7 @@ def _extract_topk_jit(q_attrs, d_attrs, carry_d, carry_i, *, n_real,
     grid = (qb // tq, b // tn)
     kern = functools.partial(_kernel, kc=kc, fresh=fresh, ne=ne,
                              unroll=unroll, block_skip=block_skip,
-                             mxu_gate=mxu_gate)
+                             mxu_gate=mxu_gate, precision=precision)
     out_d, out_i, out_iters = pl.pallas_call(
         kern,
         grid=grid,

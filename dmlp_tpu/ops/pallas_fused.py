@@ -58,15 +58,18 @@ def fused_enabled() -> bool:
 
 
 def _resolve_variant(kc: int, b: int, qb: int | None = None,
-                     a: int | None = None) -> dict:
+                     a: int | None = None,
+                     precision: str = "f32") -> dict:
     """Fused-namespace variant resolution: the measured tune-cache entry
-    for (device kind, bucket(b), bucket(a), kc) under kernel
+    for (device kind, bucket(b), bucket(a), kc, precision) under kernel
     "fused_topk" when one exists and still passes the full supports
     gate, else the shared deterministic heuristic — exactly the
     extract kernel's resolution contract, keyed separately because the
-    MXU gate shifts which tiles win."""
+    MXU gate shifts which tiles win (and per first-pass precision,
+    because the MXU pass count per tile does too)."""
     from dmlp_tpu.tune import lookup_variant
-    cached = lookup_variant(kc, b, a=a, kernel=FUSED_KERNEL)
+    cached = lookup_variant(kc, b, a=a, kernel=FUSED_KERNEL,
+                            precision=precision)
     if cached is not None:
         if qb is None or a is None \
                 or variant_supports(qb, b, a, kc, cached):
@@ -75,10 +78,11 @@ def _resolve_variant(kc: int, b: int, qb: int | None = None,
 
 
 def resolve_variant(kc: int, b: int, qb: int | None = None,
-                    a: int | None = None) -> dict:
+                    a: int | None = None,
+                    precision: str = "f32") -> dict:
     """Public form (spans/artifacts report it): the variant fused_topk
     will run with at this dispatch shape."""
-    return dict(_resolve_variant(kc, b, qb, a))
+    return dict(_resolve_variant(kc, b, qb, a, precision))
 
 
 def supports(qb: int, b: int, a: int, kc: int) -> bool:
@@ -89,16 +93,16 @@ def supports(qb: int, b: int, a: int, kc: int) -> bool:
 
 
 def variant_for(impl: str, kc: int, b: int, qb: int | None = None,
-                a: int | None = None) -> dict:
+                a: int | None = None, precision: str = "f32") -> dict:
     """The variant an ``impl`` label ("fused" | "extract", from
     resolve_topk_kernel) will actually run with at this dispatch shape —
     the one helper engines use for span/artifact reporting, so the
-    reported variant always comes from the SAME namespace the dispatch
-    resolves through."""
+    reported variant always comes from the SAME namespace (and
+    precision key axis) the dispatch resolves through."""
     if impl == "fused":
-        return resolve_variant(kc, b, qb, a)
+        return resolve_variant(kc, b, qb, a, precision)
     from dmlp_tpu.ops.pallas_extract import resolve_variant as _rv
-    return _rv(kc, b, qb, a)
+    return _rv(kc, b, qb, a, precision)
 
 
 def fused_topk(q_attrs: jax.Array, d_attrs: jax.Array,
@@ -106,11 +110,15 @@ def fused_topk(q_attrs: jax.Array, d_attrs: jax.Array,
                carry_i: jax.Array | None = None, *, n_real,
                id_base=0, kc: int, interpret: bool = False,
                block_skip: bool = True,
-               floor: jax.Array | None = None):
+               floor: jax.Array | None = None, precision: str = "f32"):
     """Drop-in for ops.pallas_extract.extract_topk with the MXU tile
     gate on and variants resolved from the fused tune-cache namespace.
     Same signature, same (dists, ids, iters) outputs, bit-identical
     results; ``iters`` reports 0 for blocks either gate elided.
+    ``precision`` ("f32" | "bf16") selects the first-pass dot dtype
+    exactly as in extract_topk — the MXU-gate bound widens by the
+    engine.finalize.lowp_eps margin in-kernel, so gating stays sound
+    under the low-precision pass.
 
     The variant resolution happens HERE, outside the jit boundary, so
     the concrete fused/two-pass choice AND the concrete tiles are part
@@ -118,13 +126,13 @@ def fused_topk(q_attrs: jax.Array, d_attrs: jax.Array,
     R203). Gate on supports() first.
     """
     v = _resolve_variant(kc, d_attrs.shape[0], q_attrs.shape[0],
-                         q_attrs.shape[1])
+                         q_attrs.shape[1], precision)
     return extract_topk(
         q_attrs, d_attrs, carry_d, carry_i, n_real=n_real,
         id_base=id_base, kc=kc, interpret=interpret,
         tile_q=v["tile_q"], tile_n=v.get("tile_n", _TN), ne=v["ne"],
         unroll=v["unroll"], block_skip=block_skip, mxu_gate=True,
-        floor=floor)
+        floor=floor, precision=precision)
 
 
 def resolve_topk_kernel(qb: int, b: int, a: int, kc: int,
@@ -135,14 +143,14 @@ def resolve_topk_kernel(qb: int, b: int, a: int, kc: int,
 
     Preference order: the fused megakernel when the kill switch allows
     it, the engine's degradation rung is still at or above "fused"
-    (the top "prune" rung composes scan pruning WITH the fused
-    kernel), and the fused variant tiles the shape; else the tuned
-    two-pass extraction kernel.
+    (the "lowp" and "prune" rungs above it compose the low-precision
+    first pass and scan pruning WITH the fused kernel), and the fused
+    variant tiles the shape; else the tuned two-pass extraction kernel.
     MUST be called OUTSIDE any jitted body (lint R203) and the returned
     label must key every compiled-program cache that bakes the choice
     in — the selection is part of the jit cache key by construction.
     """
-    if rung in ("prune", "fused") and fused_enabled() \
+    if rung in ("lowp", "prune", "fused") and fused_enabled() \
             and supports(qb, b, a, kc):
         return fused_topk, "fused"
     if extract_supports(qb, b, a, kc):

@@ -43,8 +43,8 @@ block anchors the threshold.
 
 Kill switch: ``DMLP_TPU_PRUNE=0`` disables pruning everywhere
 (mirroring ``DMLP_TPU_FUSED``); the engines additionally gate on the
-resilience ladder's top ``prune`` rung (resilience.degrade) and on
-exact mode — fast mode's output IS the device ordering and has no
+resilience ladder's top ``lowp``/``prune`` rungs (resilience.degrade)
+and on exact mode — fast mode's output IS the device ordering and has no
 repair backstop, so it always scans densely.
 
 The scoring pass has its own tune-cache namespace (``prune_score``,
@@ -63,10 +63,24 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from dmlp_tpu.engine.finalize import staging_eps
+from dmlp_tpu.engine.finalize import lowp_eps, staging_eps
 
 #: tune-cache namespace of the block-scoring pass (dmlp_tpu.tune)
 PRUNE_KERNEL = "prune_score"
+
+#: sub-block pieces per block (median split on the max-spread
+#: attribute). Whole-block boxes go VACUOUS on uniform corpora — every
+#: block's box is the full cube, every box gap is 0, every norm band
+#: straddles the query norm — so block-level pruning is geometrically
+#: impossible there. Two pieces make each box a half-cube: queries in
+#: the other half see a strictly positive gap, and the per-piece
+#: (count, upper-bound) entries sharpen the k-th threshold
+#: accumulation. 2 (not 4/8) keeps the summary footprint ~3x the
+#: whole-block one while already breaking the vacuous regime; the
+#: device scorer (score_blocks) deliberately stays whole-block — the
+#: serving micro-batch path is latency-bound on the scoring jit, and
+#: whole-block bounds are a sound (merely looser) fallback.
+PIECES = 2
 
 #: default host-scoring block chunk (blocks per vectorized slab) when
 #: no measured prune_score variant pins one: bounds the (Q, chunk, A)
@@ -108,6 +122,19 @@ class BlockSummaries:
     nmax: np.ndarray          # (B,)   f64 max row norm (-inf if empty)
     lo: np.ndarray            # (B, A) f64 box lower (+inf if empty)
     hi: np.ndarray            # (B, A) f64 box upper (-inf if empty)
+    # Optional 2-piece split summaries (PIECES; None = whole-block
+    # only, the pre-split format — every consumer falls back):
+    pcounts: Optional[np.ndarray] = None  # (B, P)    int64 rows/piece
+    pnmin: Optional[np.ndarray] = None    # (B, P)    f64 min piece norm
+    pnmax: Optional[np.ndarray] = None    # (B, P)    f64 max piece norm
+    plo: Optional[np.ndarray] = None      # (B, P, A) f64 piece box lower
+    phi: Optional[np.ndarray] = None      # (B, P, A) f64 piece box upper
+    # Per-block norm median (L2, not squared) + the EXACT count of rows
+    # at or below it — a disjoint (near-half, farther-half) norm split
+    # that tightens the k-th threshold independently of the box split:
+    nq50: Optional[np.ndarray] = None     # (B,) f64 (+inf if empty)
+    nq50_cnt: Optional[np.ndarray] = None  # (B,) int64 rows with
+    #                                        norm <= nq50
 
     @property
     def n_blocks(self) -> int:
@@ -115,8 +142,13 @@ class BlockSummaries:
 
     @property
     def nbytes(self) -> int:
-        return (self.counts.nbytes + self.nmin.nbytes + self.nmax.nbytes
+        base = (self.counts.nbytes + self.nmin.nbytes + self.nmax.nbytes
                 + self.lo.nbytes + self.hi.nbytes)
+        for extra in (self.pcounts, self.pnmin, self.pnmax, self.plo,
+                      self.phi, self.nq50, self.nq50_cnt):
+            if extra is not None:
+                base += extra.nbytes
+        return base
 
 
 def summarize_rows(rows: np.ndarray, na: int):
@@ -132,10 +164,40 @@ def summarize_rows(rows: np.ndarray, na: int):
             r.min(axis=0), r.max(axis=0))
 
 
+def split_rows(rows: np.ndarray, na: int):
+    """Piece-level summaries of one block: a median split on the
+    max-spread attribute (the kd-tree step that costs one O(m) pass),
+    plus the norm median and its EXACT cover count.
+
+    Returns ``(pieces, nq50, nq50_cnt)`` where ``pieces`` is a PIECES-
+    list of summarize_rows tuples. Any partition of the rows is sound
+    (piece bounds only ever describe real rows of the piece), so the
+    degenerate split — every row equal on the chosen attribute — just
+    halves by position. Empty blocks yield empty pieces."""
+    r = np.asarray(rows, np.float64)
+    m = r.shape[0]
+    if m == 0:
+        empty = summarize_rows(r, na)
+        return [empty] * PIECES, np.inf, 0
+    norms = np.sqrt(np.einsum("ia,ia->i", r, r))
+    nq50 = float(np.quantile(norms, 0.5))
+    nq50_cnt = int((norms <= nq50).sum())
+    spread = r.max(axis=0) - r.min(axis=0)
+    ax = int(np.argmax(spread))
+    left = r[:, ax] <= float(np.median(r[:, ax]))
+    if left.all() or not left.any():
+        left = np.arange(m) < (m // 2)
+    pieces = [summarize_rows(r[left], na), summarize_rows(r[~left], na)]
+    return pieces, nq50, nq50_cnt
+
+
 def build_summaries(attrs: np.ndarray,
-                    ranges: Sequence[Tuple[int, int]]) -> BlockSummaries:
+                    ranges: Sequence[Tuple[int, int]],
+                    pieces: int = PIECES) -> BlockSummaries:
     """Stage 0: summaries for ``attrs`` over ``ranges`` (one O(n*a)
     pass; blocks whose span is empty or past the data end count 0).
+    ``pieces`` <= 1 builds the whole-block-only format (pre-split
+    consumers, and A/B baselines for the split's win).
 
     ``attrs`` is NOT cast wholesale: a beyond-HBM corpus is held f32 on
     host precisely because an f64 copy would double host memory
@@ -150,12 +212,28 @@ def build_summaries(attrs: np.ndarray,
     nmax = np.full(nb, -np.inf)
     lo = np.full((nb, na), np.inf)
     hi = np.full((nb, na), -np.inf)
+    split = pieces > 1
+    pcounts = np.zeros((nb, PIECES), np.int64) if split else None
+    pnmin = np.full((nb, PIECES), np.inf) if split else None
+    pnmax = np.full((nb, PIECES), -np.inf) if split else None
+    plo = np.full((nb, PIECES, na), np.inf) if split else None
+    phi = np.full((nb, PIECES, na), -np.inf) if split else None
+    nq50 = np.full(nb, np.inf) if split else None
+    nq50_cnt = np.zeros(nb, np.int64) if split else None
     for b, (blo, bhi) in enumerate(ranges):
         blo, bhi = max(blo, 0), min(bhi, n)
+        rows = attrs[blo:bhi]
         counts[b], nmin[b], nmax[b], lo[b], hi[b] = summarize_rows(
-            attrs[blo:bhi], na)
+            rows, na)
+        if split:
+            pc, nq50[b], nq50_cnt[b] = split_rows(rows, na)
+            for p, (cm, cn, cx, cl, ch) in enumerate(pc):
+                pcounts[b, p], pnmin[b, p], pnmax[b, p] = cm, cn, cx
+                plo[b, p], phi[b, p] = cl, ch
     return BlockSummaries(list((int(a), int(b)) for a, b in ranges),
-                          counts, nmin, nmax, lo, hi)
+                          counts, nmin, nmax, lo, hi,
+                          pcounts, pnmin, pnmax, plo, phi,
+                          nq50, nq50_cnt)
 
 
 def update_block(summ: BlockSummaries, b: int, rows: np.ndarray,
@@ -164,12 +242,20 @@ def update_block(summ: BlockSummaries, b: int, rows: np.ndarray,
     serving ingest path: a ``dynamic_update_slice`` row append must
     invalidate/rebuild the touched blocks' summaries — a stale summary
     is silent unsoundness, the one failure mode pruning cannot repair
-    after the fact)."""
+    after the fact). Piece summaries (when the format carries them)
+    rebuild in the same call, for the same reason."""
     if lo_hi is not None:
         summ.ranges[b] = (int(lo_hi[0]), int(lo_hi[1]))
+    na = summ.lo.shape[1]
+    rows = np.asarray(rows, np.float64)
     (summ.counts[b], summ.nmin[b], summ.nmax[b],
-     summ.lo[b], summ.hi[b]) = summarize_rows(
-        np.asarray(rows, np.float64), summ.lo.shape[1])
+     summ.lo[b], summ.hi[b]) = summarize_rows(rows, na)
+    if summ.pcounts is not None:
+        pc, summ.nq50[b], summ.nq50_cnt[b] = split_rows(rows, na)
+        for p, (cm, cn, cx, cl, ch) in enumerate(pc):
+            summ.pcounts[b, p], summ.pnmin[b, p], summ.pnmax[b, p] = \
+                cm, cn, cx
+            summ.plo[b, p], summ.phi[b, p] = cl, ch
 
 
 def block_bounds(queries: np.ndarray, summ: BlockSummaries,
@@ -210,6 +296,51 @@ def block_bounds(queries: np.ndarray, summ: BlockSummaries,
     return lb, ub
 
 
+def piece_bounds(queries: np.ndarray, summ: BlockSummaries,
+                 block_chunk: Optional[int] = None
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-(query, block, piece) bounds, f64: the block_bounds formulas
+    over the PIECE norm bands / boxes. ``plb[q, b, p]`` lower-bounds
+    the squared distance to any real row of piece p, ``pub`` upper-
+    bounds it to every row (+inf for empty pieces). On a uniform
+    corpus the whole-block gap is identically 0 while the half-cube
+    piece gap is positive for every query in the other half — the
+    non-vacuity the split buys. Requires the split format
+    (``summ.pcounts is not None``)."""
+    q = np.asarray(queries, np.float64)
+    nq_, na = q.shape
+    nb = summ.n_blocks
+    npieces = summ.pcounts.shape[1]
+    qnorm = np.sqrt(np.einsum("qa,qa->q", q, q))
+    plb = np.empty((nq_, nb, npieces))
+    pub = np.empty((nq_, nb, npieces))
+    # Same chunking as block_bounds, halved: the (Q, chunk, P, A) temp
+    # is P times the whole-block slab.
+    chunk = block_chunk or max(
+        1, resolve_score_variant(nb, na)["tile_q"] // npieces)
+    for b0 in range(0, nb, chunk):
+        b1 = min(b0 + chunk, nb)
+        nmin, nmax = summ.pnmin[b0:b1], summ.pnmax[b0:b1]   # (c, P)
+        band = np.maximum(nmin[None] - qnorm[:, None, None],
+                          qnorm[:, None, None] - nmax[None])
+        lbn = np.square(np.maximum(band, 0.0))
+        dlo = summ.plo[None, b0:b1] - q[:, None, None, :]
+        dhi = q[:, None, None, :] - summ.phi[None, b0:b1]
+        gap = np.maximum(np.maximum(dlo, dhi), 0.0)
+        lbb = np.einsum("qbpa,qbpa->qbp", gap, gap)
+        plb[:, b0:b1] = np.maximum(lbn, lbb)
+        far = np.maximum(
+            np.abs(q[:, None, None, :] - summ.plo[None, b0:b1]),
+            np.abs(q[:, None, None, :] - summ.phi[None, b0:b1]))
+        ubb = np.einsum("qbpa,qbpa->qbp", far, far)
+        pub[:, b0:b1] = np.minimum(
+            ubb, np.square(qnorm[:, None, None] + nmax[None]))
+    emptyp = summ.pcounts <= 0
+    plb[:, emptyp] = np.inf
+    pub[:, emptyp] = np.inf
+    return plb, pub
+
+
 def kth_thresholds(ub: np.ndarray, counts: np.ndarray,
                    ks: np.ndarray) -> np.ndarray:
     """Per-query upper bound on the true k-th-best squared distance:
@@ -228,8 +359,8 @@ def kth_thresholds(ub: np.ndarray, counts: np.ndarray,
 
 
 def prune_mask(queries: np.ndarray, ks: np.ndarray,
-               summ: BlockSummaries, *, staging: str = "float32"
-               ) -> Tuple[np.ndarray, Dict]:
+               summ: BlockSummaries, *, staging: str = "float32",
+               precision: str = "f32") -> Tuple[np.ndarray, Dict]:
     """Stage 1 on host (f64): the survivor mask over ``summ``'s blocks
     for this query batch, plus a stats record.
 
@@ -239,18 +370,43 @@ def prune_mask(queries: np.ndarray, ks: np.ndarray,
     (engine.finalize.staging_eps, evaluated at the threshold), which
     dominates both the f64 rounding of the bound arithmetic and the
     staging-dtype/f32 perturbation of any distance the exact stage
-    will later compare. By construction at least one block survives
-    per query with a finite threshold (the block anchoring the
-    threshold bounds itself), so a schedule is never empty.
+    will later compare. A "bf16" first pass (engine "lowp" rung)
+    additionally widens eps by the finalize.lowp_eps cast bound: the
+    survivor scan's device distances then err by cast + staging, and a
+    pruned block must clear both. By construction at least one block
+    survives per query with a finite threshold (the block/piece
+    anchoring the threshold bounds itself), so a schedule is never
+    empty.
+
+    With the split format, three INDEPENDENTLY sound k-th thresholds
+    combine by elementwise min — block-level, per-piece, and the
+    per-block norm split ((nq50_cnt rows within (|q| + nq50)^2, the
+    rest within the block ub); each accumulates DISJOINT row groups,
+    which the accumulation requires (overlapping groups would double-
+    count coverage) — and the block lower bound sharpens to the max of
+    the whole-box bound and the min over its pieces' bounds.
     """
     q = np.asarray(queries, np.float64)
     na = q.shape[1]
     lb, ub = block_bounds(q, summ)
     thr = kth_thresholds(ub, summ.counts, ks)
+    plb = None
+    if summ.pcounts is not None:
+        plb, pub = piece_bounds(q, summ)
+        lb = np.maximum(lb, plb.min(axis=2))
+        thr = np.minimum(thr, kth_thresholds(
+            pub.reshape(len(q), -1), summ.pcounts.reshape(-1), ks))
+        qnorm = np.sqrt(np.einsum("qa,qa->q", q, q))
+        near = np.square(qnorm[:, None] + summ.nq50[None, :])
+        thr = np.minimum(thr, kth_thresholds(
+            np.concatenate([near, ub], axis=1),
+            np.concatenate([summ.nq50_cnt,
+                            summ.counts - summ.nq50_cnt]), ks))
     live = summ.counts > 0
     dn_max = float(np.square(summ.nmax[live]).max()) if live.any() else 0.0
     qn = np.einsum("qa,qa->q", q, q)
-    eps = staging_eps(thr, qn, dn_max, staging, na)
+    eps = staging_eps(thr, qn, dn_max, staging, na) \
+        + lowp_eps(precision, qn, dn_max)
     keep = lb <= (thr + eps)[:, None]
     survivors = live & keep.any(axis=0)
     total = int(live.sum())
@@ -261,6 +417,16 @@ def prune_mask(queries: np.ndarray, ks: np.ndarray,
         "pruned_fraction": round(pruned / total, 6) if total else 0.0,
         "summary_bytes": int(summ.nbytes),
     }
+    if plb is not None:
+        # Non-vacuity meter of the split: fraction of (query, live
+        # piece) pairs whose lower bound is strictly positive. On a
+        # uniform corpus the whole-block version of this is provably
+        # 0.0 (full-cube boxes, straddled norm bands); the half-cube
+        # pieces keep it > 0, which tests/test_prune assert.
+        livep = (summ.pcounts > 0).reshape(-1)
+        flat = plb.reshape(len(q), -1)[:, livep]
+        stats["lb_positive_fraction"] = (
+            round(float((flat > 0.0).mean()), 6) if flat.size else 0.0)
     return survivors, stats
 
 

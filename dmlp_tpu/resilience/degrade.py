@@ -5,32 +5,45 @@ fault injector, or a real XLA OOM — resilience.retry.classify treats
 them identically) the single-chip solve steps DOWN a ladder instead of
 crashing, and every rung preserves the contract checksums exactly:
 
-1. ``prune``      — the normal path: the bound-based pruned two-stage
-                    solve (ops.summaries) over the fused megakernel —
-                    only survivor blocks are staged/folded. The first
-                    thing an OOM gives back is the summary state and
-                    the scoring pass. The ``DMLP_TPU_PRUNE=0`` kill
-                    switch pins this rung to the dense fused solve
-                    without consuming a ladder step.
-2. ``fused``      — the dense scan on the fused distance→top-k
+1. ``lowp``       — the normal path: the bound-based pruned two-stage
+                    solve COMPOSED with the low-precision first pass
+                    (``config.precision``/``$DMLP_TPU_PRECISION``
+                    resolving to "bf16"): one MXU pass per tile
+                    instead of HIGHEST-precision f32's ~3, candidate
+                    windows and every prune/gate threshold widened by
+                    the analytic ``engine.finalize.lowp_eps`` bound.
+                    With precision resolving to "f32" (the default and
+                    the ``DMLP_TPU_PRECISION=f32`` kill switch) this
+                    rung is exactly the pruned solve — the kill switch
+                    pins the precision without consuming a ladder
+                    step. An OOM steps down to the f32 first pass (a
+                    bf16-inflated candidate window is the first
+                    allocation to give back).
+2. ``prune``      — the bound-based pruned two-stage solve
+                    (ops.summaries) over the fused megakernel at f32 —
+                    only survivor blocks are staged/folded. The
+                    ``DMLP_TPU_PRUNE=0`` kill switch pins this rung to
+                    the dense fused solve without consuming a ladder
+                    step.
+3. ``fused``      — the dense scan on the fused distance→top-k
                     streaming megakernel (ops.pallas_fused) where its
                     supports() holds, two-pass extraction otherwise.
                     The ``DMLP_TPU_FUSED=0`` kill switch (mirroring
                     ``DMLP_TPU_RESILIENCE``) pins this rung to the
                     two-pass kernel without consuming a ladder step.
-3. ``tuned``      — the two-pass extraction kernel with the autotuner's
+4. ``tuned``      — the two-pass extraction kernel with the autotuner's
                     cached variant (dmlp_tpu.tune): the fused kernel's
                     (identical-size, but separately-tuned) tiles are
                     the first thing to give back on a fused-path OOM.
-4. ``heuristic``  — the extraction kernel with the heuristic variant
+5. ``heuristic``  — the extraction kernel with the heuristic variant
                     (tune-cache lookups suppressed): a swept variant's
                     larger tiles are the next allocation to give back;
                     results are bit-identical by the PR 3 contract.
-5. ``streaming``  — the chunked multipass streaming fold
+6. ``streaming``  — the chunked multipass streaming fold
                     (engine.single._solve_pipelined): no running-list
                     kernel state, the live tile shrinks to one
                     (query_block x chunk) slab.
-6. ``host``       — the float64 golden solve on the host
+7. ``host``       — the float64 golden solve on the host
                     (golden.fast.knn_golden_fast): zero device memory;
                     it IS the oracle the contract diffs against, so
                     byte-identity is by construction.
@@ -48,21 +61,23 @@ from typing import Callable, List
 from dmlp_tpu.resilience import stats
 from dmlp_tpu.resilience.retry import classify, resilience_enabled
 
-RUNGS = ("prune", "fused", "tuned", "heuristic", "streaming", "host")
+RUNGS = ("lowp", "prune", "fused", "tuned", "heuristic", "streaming",
+         "host")
 
 
 @contextlib.contextmanager
 def _rung_context(engine, rung: str):
     """Configure the engine for one rung. ``_degrade_rung`` is consulted
     by engine.single._solve/_solve_segments (``streaming`` skips every
-    extract-kernel path; only the top ``prune`` rung may run the
-    bound-based scan pruning) and by
-    ops.pallas_fused.resolve_topk_kernel (the ``prune``/``fused``
-    rungs may dispatch the fused megakernel); ``heuristic`` suppresses
-    autotuner cache lookups for the duration."""
+    extract-kernel path; the top ``lowp``/``prune`` rungs may run the
+    bound-based scan pruning, and only ``lowp`` may run the bf16 first
+    pass) and by ops.pallas_fused.resolve_topk_kernel (the ``lowp``/
+    ``prune``/``fused`` rungs may dispatch the fused megakernel);
+    ``heuristic`` suppresses autotuner cache lookups for the
+    duration."""
     prev = getattr(engine, "_degrade_rung", "fused")
     engine._degrade_rung = rung
-    # Live rung gauge: numeric ladder position (0 = prune ... 5 = host)
+    # Live rung gauge: numeric ladder position (0 = lowp ... 6 = host)
     # so a scrape mid-incident sees WHERE the solve currently sits.
     from dmlp_tpu.obs import telemetry
     telemetry.registry().gauge("resilience.degrade_rung").set(
@@ -95,10 +110,11 @@ def run_ladder(engine, inp, solve: Callable):
 
     ``DMLP_TPU_RESILIENCE=0`` disables the LADDER (no step-downs), not
     the top rung's feature set: the solve still runs at RUNGS[0], so
-    the pruned two-stage solve keeps its own kill switch
-    (``DMLP_TPU_PRUNE``) instead of silently riding the resilience
-    one — the chaos overhead A/B's resilience-off arm must differ
-    from the on arm by the wrappers only."""
+    the low-precision first pass and the pruned two-stage solve keep
+    their own kill switches (``DMLP_TPU_PRECISION``/``DMLP_TPU_PRUNE``)
+    instead of silently riding the resilience one — the chaos overhead
+    A/B's resilience-off arm must differ from the on arm by the
+    wrappers only."""
     if not resilience_enabled():
         engine.last_degrade_rung = RUNGS[0]
         with _rung_context(engine, RUNGS[0]):

@@ -75,6 +75,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    choices=["auto", "sort", "topk", "seg", "extract"])
     p.add_argument("--dtype", default="auto",
                    choices=["auto", "float32", "bfloat16"])
+    p.add_argument("--precision", default="auto",
+                   choices=["auto", "f32", "bf16"],
+                   help="first-pass dot precision for the extract-path "
+                        "kernels; bf16 widens candidate windows by the "
+                        "analytic lowp_eps bound and keeps responses "
+                        "byte-identical via the f64 rescore + repair "
+                        "(plan frozen at startup; $DMLP_TPU_PRECISION "
+                        "=f32 is the live kill switch). Fleet replicas "
+                        "inherit this through --spawn-flags.")
     p.add_argument("--data-block", type=int, default=None)
     p.add_argument("--warm-buckets", default=None, metavar="NQxK,...",
                    help="extra shape buckets to compile before ready")
@@ -149,7 +158,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         warm = default_warm_buckets(corpus) + warm
     config = EngineConfig(dtype=args.dtype, select=args.select,
                           use_pallas=args.pallas,
-                          data_block=args.data_block)
+                          data_block=args.data_block,
+                          precision=args.precision)
     mesh_shape = None
     if args.mesh:
         try:

@@ -57,7 +57,8 @@ import numpy as np
 from dmlp_tpu.config import EngineConfig
 from dmlp_tpu.engine.single import (_BF16_AUTO_K_CAP, ChunkThrottle,
                                     SingleChipEngine, _extract_finalize,
-                                    _topk_blocks, fit_blocks, np_staging_dtype,
+                                    _topk_blocks, active_precision,
+                                    fit_blocks, np_staging_dtype,
                                     plan_chunks, resilient_get, resolve_kcap,
                                     round_up, stage_put)
 from dmlp_tpu.io.grammar import KNNInput, Params
@@ -316,6 +317,14 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
             raise ValueError(f"capacity {cap} < corpus rows {n}")
         self.num_attrs = na
         self.gate_carry = bool(gate_carry)
+        # First-pass precision PLAN, frozen at construction like every
+        # other resident shape decision: bucket kcaps, the staged
+        # summary-eps constants, and the active cast (engine.single
+        # .active_precision clamps to this) all derive from ONE plan,
+        # so an env flip mid-serve can disable the bf16 pass (windows
+        # merely stay wider than needed) but can never run it against
+        # windows that were planned f32.
+        self._precision_plan = cfg.resolve_precision()
 
         # -- plan the streaming layout once, at capacity shape ---------------
         self._stream_select = cfg.resolve_streaming_select(
@@ -417,7 +426,8 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
 
     def _kcap_for(self, kb: int) -> int:
         return resolve_kcap(self.config, kb, self._stream_select,
-                            self.capacity_rows, staging=self._staging)
+                            self.capacity_rows, staging=self._staging,
+                            precision=self._precision_plan)
 
     def bucket_plan(self, nq: int, kmax: int) -> Tuple[int, int, int]:
         """(qpad, k-bucket, kcap) for a request/batch shape — the ONE
@@ -516,14 +526,22 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
             self._ex_nchunks)
 
     def _stage_summaries(self) -> None:
-        from dmlp_tpu.engine.finalize import (EPS_CANCEL_COEF,
+        from dmlp_tpu.engine.finalize import (EPS_CANCEL_COEF, LOWP_COEF,
                                               EPS_REL_BF16, EPS_REL_F32)
         from dmlp_tpu.ops import summaries as osum
         dev = osum.stage_summaries(self._summ)
         rel = EPS_REL_BF16 if self._staging == "bfloat16" else EPS_REL_F32
+        # score_blocks widens thresholds by eps_rel*sqrt(thr*scale) +
+        # eps_cancel*scale with scale = qn + dn_max; lowp_eps is
+        # LOWP_COEF*scale, so folding the plan's coefficient into the
+        # staged eps_cancel scalar composes the bf16 first-pass bound
+        # additively — exactly prune_mask's precision widening. Plan-
+        # level (not per-rung): on the f32 rungs the extra slack only
+        # keeps a few more blocks, never drops one.
         dev["eps_rel"] = jax.device_put(np.float32(rel))
         dev["eps_cancel"] = jax.device_put(
-            np.float32(EPS_CANCEL_COEF * (self.num_attrs + 2)))
+            np.float32(EPS_CANCEL_COEF * (self.num_attrs + 2)
+                       + LOWP_COEF[self._precision_plan]))
         self._summ_dev = dev
 
     def _rebuild_summary_blocks(self, blocks) -> None:
@@ -697,7 +715,8 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
         (mask, stats) or (None, None) for a dense fold."""
         from dmlp_tpu.obs import counters as obs_counters
         from dmlp_tpu.ops import summaries as osum
-        if (self._summ_dev is None or self._degrade_rung != "prune"
+        if (self._summ_dev is None
+                or self._degrade_rung not in ("lowp", "prune")
                 or not self.config.exact or not osum.prune_enabled()):
             return None, None
         nq = inp.params.num_queries
@@ -738,6 +757,7 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
             return None
         nq = inp.params.num_queries
         na = self.num_attrs
+        prec = active_precision(self)  # plan-clamped; outside the jits
         q = np.zeros((entry.qpad, na), np.float32)
         q[:nq] = inp.query_attrs
         q_dev = stage_put(q, self._staging)
@@ -767,7 +787,8 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
                     continue
                 od, oi, iters = kern(q_dev, self._chunks[c], od, oi,
                                      n_real=nr, id_base=lo, kc=entry.kcap,
-                                     interpret=self._interpret)
+                                     interpret=self._interpret,
+                                     precision=prec)
                 scanned += nr * na * item
                 z = jnp.sum(iters == 0)
                 gz = z if gz is None else gz + z
@@ -837,6 +858,7 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
         na = self.num_attrs
         n = self.n_real
         cr = self._ex_chunk_rows
+        prec = active_precision(self)  # plan-clamped; outside the jits
         q = np.zeros((entry.qpad, na), np.float32)
         q[:nq] = inp.query_attrs
         q_dev = stage_put(q, self._staging)
@@ -854,7 +876,8 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
                     continue
                 od, oi, _its = kern(q_dev, self._chunks[c], od, oi,
                                     n_real=nr, id_base=lo, kc=kc,
-                                    interpret=self._interpret)
+                                    interpret=self._interpret,
+                                    precision=prec)
                 throttle.tick(od)
                 telemetry.sample_memory_now()
             if od is None:
@@ -869,17 +892,20 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
             fds = []
             for _p in range(1, npasses):
                 floor_dev, fd = _mp_floor(ods[-1], qn_dev, dn_dev,
-                                          staging=self._staging, na=na)
+                                          staging=self._staging, na=na,
+                                          precision=prec)
                 fds.append(fd)
                 od, oi, _its = kern_full(q_dev, d_full, n_real=n,
                                          id_base=0, kc=kc,
                                          interpret=self._interpret,
-                                         floor=floor_dev)
+                                         floor=floor_dev,
+                                         precision=prec)
                 throttle.tick(od)
                 ods.append(od)
                 ois.append(oi)
             fds.append(_mp_floor(ods[-1], qn_dev, dn_dev,
-                                 staging=self._staging, na=na)[1])
+                                 staging=self._staging, na=na,
+                                 precision=prec)[1])
             top, valid = _mp_merge(jnp.concatenate(ods, axis=1),
                                    jnp.concatenate(ois, axis=1),
                                    self._d_labels, kcap=kcap)
@@ -1056,6 +1082,9 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
             "summary_rebuilds": self.summary_rebuilds,
             "last_prune_fraction": self.last_prune_fraction,
             "last_prune": dict(lp) if isinstance(lp, dict) else None,
+            "precision_plan": self._precision_plan,
+            "last_precision": dict(self.last_precision)
+            if isinstance(self.last_precision, dict) else None,
         }
 
 

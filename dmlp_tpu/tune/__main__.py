@@ -10,10 +10,17 @@ The sweep measures at the CHUNKED dispatch shape the engines actually
 use (plan_chunks on the extract granule) and merges winners into the
 cache file (``$DMLP_TPU_TUNE_CACHE`` or
 ``~/.cache/dmlp_tpu/extract_variants.json``) keyed by (kernel, device
-kind, data-rows bucket, kc, dtype). Existing entries for other keys are
-kept. ``--kernel extract|fused|both`` (default both) picks which
-kernel's variant space to sweep — the fused megakernel
-(ops.pallas_fused) caches under its own namespace.
+kind, data-rows bucket, kc, dtype, precision). Existing entries for
+other keys are kept. ``--kernel extract|fused|both|prune_score|all``
+(default both) picks which kernel's variant space to sweep — the fused
+megakernel (ops.pallas_fused) caches under its own namespace, and
+``prune_score`` sweeps the HOST block-scoring chunk that
+ops.summaries.resolve_score_variant reads (satellite of the
+low-precision first pass: the measured tiling replaces the guessed
+_SCORE_BLOCK_CHUNK default). ``--precision f32|bf16|both`` (default
+f32) re-sweeps the device kernels per first-pass dot precision — a
+bf16 pass changes the MXU pass count per tile, so the winning tiles
+differ and persist under the precision key axis (cache schema 3).
 
 ``--smoke`` runs a tiny-shape sweep (CPU interpret mode works) over a
 4-variant slice PER KERNEL — the ``make tune-smoke`` CI gate that
@@ -41,11 +48,20 @@ def main(argv=None) -> int:
                     help="candidate-list width to tune directly "
                          "(repeatable; overrides --k derivation)")
     ap.add_argument("--reps", type=int, default=3)
-    ap.add_argument("--kernel", choices=("extract", "fused", "both"),
+    ap.add_argument("--kernel",
+                    choices=("extract", "fused", "both",
+                             "prune_score", "all"),
                     default="both",
                     help="which kernel's variant space to sweep (the "
                          "fused megakernel caches under its own "
-                         "namespace)")
+                         "namespace; prune_score sweeps the host "
+                         "block-scoring chunk; all = every kernel)")
+    ap.add_argument("--precision", choices=("f32", "bf16", "both"),
+                    default="f32",
+                    help="first-pass dot precision(s) to sweep the "
+                         "device kernels at — winners persist under "
+                         "the cache's precision key axis (prune_score "
+                         "is host f64 and ignores this)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None,
                     help="cache file (default: the lookup path — "
@@ -76,7 +92,8 @@ def main(argv=None) -> int:
               f"({args.validate})")
         return 0
 
-    from dmlp_tpu.tune.sweep import smoke_space, sweep_extract
+    from dmlp_tpu.tune.sweep import (smoke_space, sweep_extract,
+                                     sweep_prune_score)
 
     if args.smoke:
         n, nq, a = 1024, 16, 8
@@ -98,17 +115,31 @@ def main(argv=None) -> int:
                           for k in ks})
 
     out_path = args.out or cache_path()
-    kernels = ("extract", "fused") if args.kernel == "both" \
-        else (args.kernel,)
+    kernels = {"both": ("extract", "fused"),
+               "all": ("extract", "fused", "prune_score")}.get(
+        args.kernel, (args.kernel,))
+    precisions = ("f32", "bf16") if args.precision == "both" \
+        else (args.precision,)
     print(f"tune: sweeping {'+'.join(kernels)} variants at n={n} q={nq} "
-          f"a={a} kcs={kcs} reps={reps} -> {out_path}", flush=True)
+          f"a={a} kcs={kcs} reps={reps} "
+          f"precisions={'+'.join(precisions)} -> {out_path}",
+          flush=True)
     kwargs = {} if space_fn is None else {"space_fn": space_fn}
     winners, rows = [], []
     for kern in kernels:
-        w, r = sweep_extract(n, nq, a, kcs, reps=reps, seed=args.seed,
-                             out=sys.stdout, kernel=kern, **kwargs)
-        winners += w
-        rows += r
+        if kern == "prune_score":
+            # Host f64 scoring has no first-pass precision axis.
+            w, r = sweep_prune_score(n, nq, a, reps=reps,
+                                     seed=args.seed, out=sys.stdout)
+            winners += w
+            rows += r
+            continue
+        for prec in precisions:
+            w, r = sweep_extract(n, nq, a, kcs, reps=reps,
+                                 seed=args.seed, out=sys.stdout,
+                                 kernel=kern, precision=prec, **kwargs)
+            winners += w
+            rows += r
     if not winners:
         print("tune: FAIL — no variant measured for any kc",
               file=sys.stderr)
@@ -126,8 +157,10 @@ def main(argv=None) -> int:
     for w in winners:
         cache.put(kind, w["b"], w["kc"], w["variant"], a=a,
                   dtype="float32",
-                  kernel="fused_topk" if w["kernel"] == "fused"
-                  else "extract_topk",
+                  kernel={"fused": "fused_topk",
+                          "prune_score": "prune_score"}.get(
+                      w["kernel"], "extract_topk"),
+                  precision=w.get("precision", "f32"),
                   measured_ms=w["measured_ms"],
                   swept=w["swept"], shape=(w["qb"], w["b"], a))
     cache.save(out_path)
@@ -146,7 +179,9 @@ def main(argv=None) -> int:
     print(json.dumps({"device_kind": kind, "cache": out_path,
                       "entries": len(cache.entries),
                       "winners": [{"kernel": w["kernel"], "kc": w["kc"],
-                                   "b": w["b"], "variant": w["variant"]}
+                                   "b": w["b"], "variant": w["variant"],
+                                   "precision": w.get("precision",
+                                                      "f32")}
                                   for w in winners]}))
     return 0
 

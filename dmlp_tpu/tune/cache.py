@@ -1,15 +1,20 @@
 """Persisted variant cache for the measured autotuner.
 
 One small versioned JSON file maps (kernel, device kind, data-rows shape
-bucket, kc, dtype) -> the fastest measured kernel variant. The file is
-written by the sweep (``python -m dmlp_tpu.tune``) and read on the hot
-path by ``ops.pallas_extract._resolve_variant`` (kernel "extract_topk")
-and ``ops.pallas_fused._resolve_variant`` (kernel "fused_topk") through
-:func:`lookup_variant`. Schema 2 added the per-entry kernel namespace:
-the fused megakernel's MXU gate shifts which tiles win, so the two
-kernels sweep and cache independently; schema-1 files (extract-only)
-still LOAD — their keys upgrade to the extract namespace in memory —
-but saves always write schema 2.
+bucket, kc, dtype, precision) -> the fastest measured kernel variant.
+The file is written by the sweep (``python -m dmlp_tpu.tune``) and read
+on the hot path by ``ops.pallas_extract._resolve_variant`` (kernel
+"extract_topk") and ``ops.pallas_fused._resolve_variant`` (kernel
+"fused_topk") through :func:`lookup_variant`. Schema 2 added the
+per-entry kernel namespace: the fused megakernel's MXU gate shifts
+which tiles win, so the two kernels sweep and cache independently.
+Schema 3 added the first-pass precision axis: a bf16 dot spends one
+MXU pass per tile where HIGHEST-precision f32 spends ~3, which moves
+the compute/traffic balance — and hence the winning tile — so the two
+precisions sweep and cache independently. Old files still LOAD:
+schema-1 keys upgrade to the extract namespace, schema-1 AND schema-2
+keys take the "f32" precision suffix in memory (every pre-schema-3
+measurement WAS an f32-pass measurement); saves always write schema 3.
 
 Design constraints, in order:
 
@@ -42,8 +47,13 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 #: bump on any backward-incompatible cache field change (2: per-entry
-#: kernel namespace — extract_topk vs the fused megakernel)
-CACHE_SCHEMA = 2
+#: kernel namespace — extract_topk vs the fused megakernel; 3: the
+#: first-pass precision key axis — f32 vs bf16 winners cached apart)
+CACHE_SCHEMA = 3
+
+#: legal first-pass precision key segments (config.EngineConfig
+#: .precision resolved; int8 is the gated ROADMAP follow-on)
+_PRECISIONS = ("f32", "bf16")
 
 #: the schema-2 envelope family; per-entry keys carry the concrete kernel
 _KERNEL_FAMILY = "pallas_topk"
@@ -78,8 +88,9 @@ def shape_bucket(b: int) -> int:
 
 
 def _key(kernel: str, device_kind: str, b_bucket: int, a_bucket: int,
-         kc: int, dtype: str) -> str:
-    return f"{kernel}|{device_kind}|b{b_bucket}|a{a_bucket}|kc{kc}|{dtype}"
+         kc: int, dtype: str, precision: str = "f32") -> str:
+    return (f"{kernel}|{device_kind}|b{b_bucket}|a{a_bucket}|kc{kc}"
+            f"|{dtype}|{precision}")
 
 
 def validate_variant(v: Any) -> bool:
@@ -128,23 +139,27 @@ class VariantCache:
     # -- mutation ------------------------------------------------------------
     def put(self, device_kind: str, b: int, kc: int, variant: Dict, *,
             a: int, dtype: str = "float32",
-            kernel: str = "extract_topk",
+            kernel: str = "extract_topk", precision: str = "f32",
             measured_ms: Optional[float] = None,
             swept: Optional[int] = None,
             shape: Optional[Tuple[int, int, int]] = None) -> str:
         """Record the winning ``variant`` for (kernel, device, bucket(b),
-        bucket(a), kc, dtype); returns the entry key. ``a`` (the swept
-        attribute width) is part of the key: the VMEM footprint — and
-        hence which variants even fit — scales with it. Raises
+        bucket(a), kc, dtype, precision); returns the entry key. ``a``
+        (the swept attribute width) is part of the key: the VMEM
+        footprint — and hence which variants even fit — scales with it.
+        ``precision`` is the first-pass dot precision the measurement
+        ran at (MXU passes per tile differ, so winners do too). Raises
         ValueError on a variant that fails structural validation — a
         sweep must never persist a variant the hot path would have to
-        reject — or on an unknown kernel namespace."""
+        reject — or on an unknown kernel namespace or precision."""
         if not validate_variant(variant):
             raise ValueError(f"invalid variant {variant!r}")
         if kernel not in _KERNELS:
             raise ValueError(f"unknown kernel namespace {kernel!r}")
+        if precision not in _PRECISIONS:
+            raise ValueError(f"unknown precision {precision!r}")
         key = _key(kernel, device_kind, shape_bucket(b), shape_bucket(a),
-                   kc, dtype)
+                   kc, dtype, precision)
         entry: Dict[str, Any] = {"variant": dict(variant),
                                  "created_unix": time.time()}
         if measured_ms is not None:
@@ -158,14 +173,15 @@ class VariantCache:
 
     # -- read ----------------------------------------------------------------
     def get(self, device_kind: str, b: int, kc: int, *, a: int,
-            dtype: str = "float32",
-            kernel: str = "extract_topk") -> Optional[Dict]:
+            dtype: str = "float32", kernel: str = "extract_topk",
+            precision: str = "f32") -> Optional[Dict]:
         """The cached variant for (kernel, device, bucket(b), bucket(a),
-        kc, dtype), after per-entry validation and the per-dispatch
-        alignment gate — None on miss, corrupt entry, or misfit."""
+        kc, dtype, precision), after per-entry validation and the
+        per-dispatch alignment gate — None on miss, corrupt entry, or
+        misfit."""
         e = self.entries.get(
             _key(kernel, device_kind, shape_bucket(b), shape_bucket(a),
-                 kc, dtype))
+                 kc, dtype, precision))
         if not isinstance(e, dict):
             return None
         v = e.get("variant")
@@ -194,14 +210,15 @@ class VariantCache:
     def validate_doc(doc: Any) -> None:
         """Raise ValueError naming the first schema violation (the
         tune-smoke CI gate calls this on the file it just wrote).
-        Accepts schema 2 (kernel-namespaced keys) and grandfathered
-        schema-1 extract-only files."""
+        Accepts schema 3 (kernel-namespaced, precision-suffixed keys)
+        and grandfathered schema-1 (extract-only) / schema-2
+        (no precision axis) files."""
         if not isinstance(doc, dict):
             raise ValueError("cache is not a JSON object")
         schema = doc.get("schema")
-        if schema not in (1, CACHE_SCHEMA):
+        if schema not in (1, 2, CACHE_SCHEMA):
             raise ValueError(f"cache schema {schema!r} not in "
-                             f"(1, {CACHE_SCHEMA}) "
+                             f"(1, 2, {CACHE_SCHEMA}) "
                              "(regenerate with python -m dmlp_tpu.tune)")
         want_kernel = _KERNEL_V1 if schema == 1 else _KERNEL_FAMILY
         if doc.get("kernel") != want_kernel:
@@ -211,9 +228,12 @@ class VariantCache:
         if not isinstance(entries, dict):
             raise ValueError("cache entries block missing or not a dict")
         for key, e in entries.items():
-            if schema == CACHE_SCHEMA \
-                    and key.split("|", 1)[0] not in _KERNELS:
+            if schema >= 2 and key.split("|", 1)[0] not in _KERNELS:
                 raise ValueError(f"entry {key!r} has no kernel namespace")
+            if schema == CACHE_SCHEMA \
+                    and key.rsplit("|", 1)[-1] not in _PRECISIONS:
+                raise ValueError(f"entry {key!r} has no precision "
+                                 "suffix")
             if not isinstance(e, dict) or not validate_variant(
                     e.get("variant")):
                 raise ValueError(f"entry {key!r} carries an invalid "
@@ -225,20 +245,29 @@ class VariantCache:
         shape) — raises on an unreadable or wrong-schema file, but a
         single corrupt ENTRY does not poison the rest: per-entry
         validation happens at ``get()``, so the file's other winners
-        stay live. Schema-1 files (extract-only, pre-fused) load
-        LENIENTLY: their keys upgrade to the extract_topk namespace in
-        memory, so a tuned machine keeps its winners across the bump
-        (the next sweep re-saves as schema 2). The strict whole-file
-        check (every entry valid) is :meth:`validate_doc` — the
-        ``--validate`` CI gate."""
+        stay live. Old files load LENIENTLY so a tuned machine keeps
+        its winners across a schema bump (the next sweep re-saves at
+        the current schema): schema-1 keys (extract-only, pre-fused)
+        upgrade to the extract_topk namespace, and schema-1/2 keys
+        (pre-precision-axis) take the "f32" suffix — every measurement
+        they carry was an f32-pass measurement, so the upgrade changes
+        the key, never the meaning. The strict whole-file check (every
+        entry valid) is :meth:`validate_doc` — the ``--validate`` CI
+        gate."""
         path = path or cache_path()
         with open(path) as f:
             doc = json.load(f)
         if isinstance(doc, dict) and doc.get("schema") == 1 \
                 and doc.get("kernel") == _KERNEL_V1 \
                 and isinstance(doc.get("entries"), dict):
-            entries = {f"{_KERNEL_V1}|{k}": e
+            entries = {f"{_KERNEL_V1}|{k}|f32": e
                        for k, e in doc["entries"].items()}
+            return cls(entries=entries,
+                       created_unix=doc.get("created_unix"))
+        if isinstance(doc, dict) and doc.get("schema") == 2 \
+                and doc.get("kernel") == _KERNEL_FAMILY \
+                and isinstance(doc.get("entries"), dict):
+            entries = {f"{k}|f32": e for k, e in doc["entries"].items()}
             return cls(entries=entries,
                        created_unix=doc.get("created_unix"))
         if not isinstance(doc, dict) or doc.get("schema") != CACHE_SCHEMA \
@@ -300,11 +329,14 @@ def lookup_variant(kc: int, b: int, a: Optional[int] = None,
                    dtype: str = "float32",
                    device_kind: Optional[str] = None,
                    path: Optional[str] = None,
-                   kernel: str = "extract_topk") -> Optional[Dict]:
+                   kernel: str = "extract_topk",
+                   precision: str = "f32") -> Optional[Dict]:
     """The hot-path read: cached variant for this dispatch, or None.
 
     ``kernel`` selects the namespace ("extract_topk" | "fused_topk" —
-    the fused megakernel sweeps and caches separately). Never raises;
+    the fused megakernel sweeps and caches separately); ``precision``
+    the first-pass-dot key axis (f32 and bf16 winners cached apart —
+    the MXU pass count per tile differs). Never raises;
     returns None when ``a`` is unknown (the attribute width is part of
     the key — every real dispatch site knows it), the cache file is
     absent, unreadable, schema-invalid, keyed for a different device
@@ -327,4 +359,5 @@ def lookup_variant(kc: int, b: int, a: Optional[int] = None,
         return None
     if device_kind is None:
         device_kind = _current_device_kind()
-    return cache.get(device_kind, b, kc, a=a, dtype=dtype, kernel=kernel)
+    return cache.get(device_kind, b, kc, a=a, dtype=dtype, kernel=kernel,
+                     precision=precision)

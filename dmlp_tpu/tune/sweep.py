@@ -32,7 +32,8 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["variant_space", "sweep_extract", "smoke_space"]
+__all__ = ["variant_space", "sweep_extract", "sweep_prune_score",
+           "smoke_space"]
 
 _TQ_CHOICES = (32, 64, 128, 256)
 _NE_CHOICES = (2, 4, 8)
@@ -107,7 +108,8 @@ def _fenced_ms(fn, q, d, reps: int) -> float:
 
 def time_variant_ms(q, d, n_real: int, kc: int, v: Dict, reps: int,
                     interpret: bool, warm_folds: int = 1,
-                    kernel: str = "extract") -> float:
+                    kernel: str = "extract",
+                    precision: str = "f32") -> float:
     """Fenced time of one kernel variant at the staged arrays: one FRESH
     dispatch plus ``warm_folds`` carry folds over the same block. The
     engines' hot path is a chunk chain — one cold fold, then warm folds
@@ -122,7 +124,7 @@ def time_variant_ms(q, d, n_real: int, kc: int, v: Dict, reps: int,
     b = d.shape[0]
     kw = dict(kc=kc, interpret=interpret, tile_q=v["tile_q"],
               tile_n=v["tile_n"], ne=v["ne"], unroll=v["unroll"],
-              mxu_gate=kernel == "fused")
+              mxu_gate=kernel == "fused", precision=precision)
 
     def fn(q_, d_):
         od, oi, _it = extract_topk(q_, d_, n_real=n_real, **kw)
@@ -136,7 +138,7 @@ def time_variant_ms(q, d, n_real: int, kc: int, v: Dict, reps: int,
 def sweep_extract(n: int, nq: int, a: int, kcs: Sequence[int],
                   reps: int = 3, seed: int = 0,
                   space_fn=variant_space, out=None,
-                  kernel: str = "extract",
+                  kernel: str = "extract", precision: str = "f32",
                   ) -> Tuple[List[Dict], List[Dict]]:
     """Measure the variant space at BOTH dispatch shapes the engines use
     for an (n, nq, a) workload and return (winners, detail rows).
@@ -154,9 +156,13 @@ def sweep_extract(n: int, nq: int, a: int, kcs: Sequence[int],
 
     Queries pad to whole query tiles. ``kernel`` ("extract" | "fused")
     selects which kernel the variants drive; winners persist under that
-    kernel's cache namespace. ``winners`` is a list of
-    {"kernel", "kc", "b", "qb", "variant", "measured_ms", "swept",
-    "skipped_compile", "kc_pad_probe_ms"?} records — one per
+    kernel's cache namespace. ``precision`` ("f32" | "bf16") selects
+    the first-pass dot dtype the variants are timed WITH — a bf16
+    first pass changes MXU pass count and hence which tile shapes win,
+    so winners carry the precision and persist under that key axis of
+    the cache (schema 3). ``winners`` is a list of
+    {"kernel", "kc", "b", "qb", "variant", "precision", "measured_ms",
+    "swept", "skipped_compile", "kc_pad_probe_ms"?} records — one per
     (kc, b point) that measured at least one variant.
     """
     import numpy as np
@@ -190,7 +196,8 @@ def sweep_extract(n: int, nq: int, a: int, kcs: Sequence[int],
             for v in space:
                 try:
                     ms = time_variant_ms(q, d, n_real, kc, v, reps,
-                                         interpret, kernel=kernel)
+                                         interpret, kernel=kernel,
+                                         precision=precision)
                 except Exception as e:  # Mosaic tiling edge: skip, count
                     skipped += 1
                     rows.append({"kernel": kernel, "kc": kc, "b": b,
@@ -206,18 +213,97 @@ def sweep_extract(n: int, nq: int, a: int, kcs: Sequence[int],
                     f"({skipped} compile-skipped of {len(space)})")
                 continue
             entry = {"kernel": kernel, "kc": kc, "b": b, "qb": qpad,
-                     "variant": best, "measured_ms": best_ms,
+                     "variant": best, "precision": precision,
+                     "measured_ms": best_ms,
                      "swept": len(space) - skipped,
                      "skipped_compile": skipped}
             # kc-padding probe: the winner at kc+8 — informational only.
             try:
                 entry["kc_pad_probe_ms"] = round(
                     time_variant_ms(q, d, n_real, kc + 8, best, reps,
-                                    interpret, kernel=kernel), 3)
+                                    interpret, kernel=kernel,
+                                    precision=precision), 3)
             except Exception:
                 pass
             winners.append(entry)
             log(f"  {kernel} b={b} kc={kc}: winner {best} at "
                 f"{best_ms:.2f} ms "
                 f"({entry['swept']} measured, {skipped} skipped)")
+    return winners, rows
+
+
+#: host block-chunk candidates for the prune_score sweep — the slab
+#: width block_bounds/piece_bounds vectorize over; bounded above so the
+#: (Q, chunk, P, A) f64 temp stays tens of MB at bench-scale q counts
+_CHUNK_CHOICES = (32, 64, 128, 256, 512)
+
+
+def sweep_prune_score(n: int, nq: int, a: int, reps: int = 3,
+                      seed: int = 0, out=None,
+                      chunks: Sequence[int] = _CHUNK_CHOICES,
+                      ) -> Tuple[List[Dict], List[Dict]]:
+    """Measured sweep of the HOST block-scoring chunk (the
+    ``prune_score`` tune-cache namespace ops.summaries.
+    resolve_score_variant reads): time prune_mask's bound computation —
+    block_bounds plus, with the split format, piece_bounds at the
+    halved chunk — per block-chunk candidate over summaries built at
+    the engines' extract-granule block layout, and return (winners,
+    rows) in the sweep_extract record shape.
+
+    The chunk trades f64 slab temp size against numpy dispatch count:
+    too small and the per-chunk einsum overhead dominates, too large
+    and the (Q, chunk, A) temp falls out of cache. Winners key at the
+    EXACT lookup point resolve_score_variant uses — kc=8 (a fixed
+    namespace tag, not a candidate width) and b=n_blocks — with
+    ``variant = {"tile_q": chunk, "ne": 1, "unroll": 1}``. Host f64
+    scoring has no low-precision first pass, so winners always carry
+    precision "f32" (the only key the resolver looks under).
+    """
+    import numpy as np
+
+    from dmlp_tpu.ops.pallas_extract import BLOCK_ROWS
+    from dmlp_tpu.ops.summaries import (PIECES, block_bounds,
+                                        build_summaries, piece_bounds)
+
+    log = (lambda *_: None) if out is None else \
+        (lambda *a_: print(*a_, file=out, flush=True))
+    rng = np.random.default_rng(seed)
+    attrs = rng.uniform(0.0, 100.0, (n, a)).astype(np.float32)
+    ranges = [(i, min(i + BLOCK_ROWS, n))
+              for i in range(0, n, BLOCK_ROWS)]
+    summ = build_summaries(attrs, ranges)
+    q = rng.uniform(0.0, 100.0, (nq, a))
+
+    def _time_chunk(chunk: int) -> float:
+        def run():
+            block_bounds(q, summ, block_chunk=chunk)
+            if summ.pcounts is not None:
+                piece_bounds(q, summ,
+                             block_chunk=max(1, chunk // PIECES))
+        run()  # warm allocator / page-fault the summary arrays
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            run()
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    winners: List[Dict] = []
+    rows: List[Dict] = []
+    best, best_ms = None, float("inf")
+    for chunk in sorted(set(int(c) for c in chunks)):
+        v = {"tile_q": chunk, "ne": 1, "unroll": 1}
+        ms = _time_chunk(chunk)
+        rows.append({"kernel": "prune_score", "kc": 8,
+                     "b": summ.n_blocks, "variant": v,
+                     "ms": round(ms, 3)})
+        log(f"  prune_score blocks={summ.n_blocks} chunk={chunk} "
+            f"-> {ms:.2f} ms")
+        if ms < best_ms:
+            best, best_ms = v, ms
+    if best is not None:
+        winners.append({"kernel": "prune_score", "kc": 8,
+                        "b": summ.n_blocks, "qb": nq, "variant": best,
+                        "precision": "f32", "measured_ms": best_ms,
+                        "swept": len(rows), "skipped_compile": 0})
+        log(f"  prune_score blocks={summ.n_blocks}: winner {best} at "
+            f"{best_ms:.2f} ms ({len(rows)} measured)")
     return winners, rows

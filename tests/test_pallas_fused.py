@@ -262,7 +262,7 @@ def test_fused_rung_degrades_to_two_pass_on_oom(monkeypatch, tmp_path):
                                       use_pallas=True)).run(inp))
 
     sched = {"schema": 1, "seed": 5, "faults": [
-        {"site": "single.stage_put", "kind": "oom", "times": 2}]}
+        {"site": "single.stage_put", "kind": "oom", "times": 3}]}
     p = tmp_path / "faults.json"
     p.write_text(json.dumps(sched))
     monkeypatch.setenv("DMLP_TPU_FAULTS", str(p))
@@ -279,7 +279,8 @@ def test_fused_rung_degrades_to_two_pass_on_oom(monkeypatch, tmp_path):
     assert eng.last_degrade_rung == "tuned"
     assert eng.last_extract_impl == "extract"
     snap = stats.snapshot()["degradations"]
-    assert "prune->fused" in snap and "fused->tuned" in snap
+    assert "lowp->prune" in snap and "prune->fused" in snap \
+        and "fused->tuned" in snap
 
 
 # -- analytic cost model -----------------------------------------------------

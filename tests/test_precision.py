@@ -1,0 +1,189 @@
+"""Low-precision first pass: analytic bound + byte-identity fuzz.
+
+Two halves of the ``precision="bf16"`` contract get hardened here:
+
+- the :func:`~dmlp_tpu.engine.finalize.lowp_eps` cast bound actually
+  upper-bounds the bf16-vs-f32 cross-term error, fuzzed on directed
+  adversarial corpora (magnitude cancellation: huge norms, tiny true
+  distances — exactly where a naive relative bound would blow up);
+- with the bound wired through the candidate windows, every engine
+  tier under a forced bf16 first pass stays BYTE-identical to its f32
+  run and to the f64 golden oracle — including duplicate-heavy tie
+  grids straddling block boundaries, where a single flipped comparison
+  in the lossy pass would reorder equal-distance neighbors.
+"""
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+
+from dmlp_tpu.config import EngineConfig
+from dmlp_tpu.engine import finalize
+from dmlp_tpu.engine.single import SingleChipEngine
+from dmlp_tpu.engine.sharded import ShardedEngine
+from dmlp_tpu.golden.reference import knn_golden
+from dmlp_tpu.io.grammar import KNNInput, Params
+from dmlp_tpu.io.report import format_results
+from dmlp_tpu.serve.engine import ResidentEngine
+from tests.test_engine_single import assert_same_results
+
+
+def _bf16(x: np.ndarray) -> np.ndarray:
+    """Round-trip through bfloat16 — the first-pass cast, in f64."""
+    return x.astype(ml_dtypes.bfloat16).astype(np.float64)
+
+
+# -- the analytic bound -------------------------------------------------------
+
+def test_lowp_eps_zero_for_f32_and_no_silent_int8():
+    qn = np.array([1.0, 4.0])
+    assert finalize.lowp_eps("f32", qn, 9.0).tolist() == [0.0, 0.0]
+    with pytest.raises(KeyError):
+        finalize.lowp_eps("int8", qn, 9.0)
+
+
+@pytest.mark.parametrize("seed", range(301, 311))
+def test_lowp_eps_bounds_bf16_cross_term_error(seed):
+    """Directed-rounding fuzz: |2(q·d − bf16(q)·bf16(d))| stays within
+    lowp_eps on cancellation-heavy corpora. The kernel perturbs ONLY
+    the cross term (norms stay f32 from exact inputs), so this is the
+    whole cast error the windows must absorb."""
+    rng = np.random.default_rng(seed)
+    na = int(rng.integers(2, 16))
+    scale = float(2.0 ** rng.integers(0, 11))     # norms up to ~2^10
+    center = rng.uniform(-1, 1, na) * scale
+    # data: a tight cluster on the center (distances ~1e-3 * scale,
+    # cross terms ~scale^2 — maximal cancellation) plus spread rows
+    n = 400
+    cluster = center + rng.normal(0, 1e-3 * scale, (n // 2, na))
+    spread = rng.uniform(-scale, scale, (n - n // 2, na))
+    data = np.vstack([cluster, spread])
+    queries = center + rng.normal(0, 1e-3 * scale, (24, na))
+    cross = queries @ data.T                       # f64 exact
+    cross_lowp = _bf16(queries) @ _bf16(data).T
+    err = 2.0 * np.abs(cross - cross_lowp)
+    qn = np.einsum("ij,ij->i", queries, queries)
+    dn_max = float(np.max(np.einsum("ij,ij->i", data, data)))
+    bound = finalize.lowp_eps("bf16", qn, dn_max)[:, None]
+    assert np.all(err <= bound), \
+        f"cast error {err.max()} exceeds lowp_eps {bound.min()}"
+
+
+# -- engine byte-identity under the forced bf16 pass --------------------------
+
+def _case(seed: int) -> KNNInput:
+    """Duplicate-biased corpora with n straddling block granules."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(120, 700))
+    nq = int(rng.integers(1, 32))
+    na = int(rng.integers(1, 9))
+    if rng.random() < 0.5:   # integer grid: exact f32 + massive ties
+        data = rng.integers(0, 3, (n, na)).astype(np.float64)
+        queries = rng.integers(0, 3, (nq, na)).astype(np.float64)
+    else:
+        data = rng.uniform(-20, 20, (n, na))
+        queries = rng.uniform(-20, 20, (nq, na))
+    labels = rng.integers(0, 5, n).astype(np.int32)
+    ks = rng.integers(1, min(n, 48) + 1, nq).astype(np.int32)
+    return KNNInput(Params(n, nq, na), labels, data, ks, queries)
+
+
+def _cfg(precision: str, **kw) -> EngineConfig:
+    return EngineConfig(select="extract", use_pallas=True,
+                        precision=precision, **kw)
+
+
+@pytest.mark.parametrize("seed", range(211, 221))
+def test_single_engine_bf16_byte_identical_to_f32_and_golden(seed):
+    inp = _case(seed)
+    got_b = SingleChipEngine(_cfg("bf16")).run(inp)
+    got_f = SingleChipEngine(_cfg("f32")).run(inp)
+    gold = knn_golden(inp)
+    assert format_results(got_b) == format_results(got_f) \
+        == format_results(gold)
+    assert_same_results(got_b, gold)
+
+
+def test_single_engine_reports_active_precision_and_inflation():
+    inp = _case(404)
+    eng = SingleChipEngine(_cfg("bf16"))
+    eng.run(inp)
+    rec = eng.last_precision
+    assert rec["active"] == "bf16" and rec["configured"] == "bf16"
+    assert rec["kcap_inflation"] > 0      # the window actually widened
+    eng_f = SingleChipEngine(_cfg("f32"))
+    eng_f.run(inp)
+    assert eng_f.last_precision["active"] == "f32"
+    assert eng_f.last_precision["kcap_inflation"] == 0
+
+
+def test_bf16_tie_grid_across_block_boundary():
+    """All-duplicate integer grid with rows astride the block edge:
+    every distance is bf16-representable, so ties are decided purely by
+    id order — a first pass that perturbed comparison order would
+    reorder the neighbor lists."""
+    rng = np.random.default_rng(77)
+    n, na = 260, 3                 # straddles the 256 block granule
+    data = rng.integers(0, 2, (n, na)).astype(np.float64)
+    data[128:140] = data[0]        # duplicate row group across chunks
+    queries = data[[0, 5, 129, 255]].copy()
+    ks = np.full(4, 48, np.int32)
+    labels = rng.integers(0, 3, n).astype(np.int32)
+    inp = KNNInput(Params(n, 4, na), labels, data, ks, queries)
+    got_b = SingleChipEngine(_cfg("bf16")).run(inp)
+    gold = knn_golden(inp)
+    assert format_results(got_b) == format_results(gold)
+    assert_same_results(got_b, gold)
+
+
+def test_sharded_engine_bf16_byte_identical():
+    inp = _case(555)
+    eng = ShardedEngine(EngineConfig(mode="sharded", select="extract",
+                                     precision="bf16", data_block=64))
+    got = eng.run(inp)
+    gold = knn_golden(inp)
+    assert format_results(got) == format_results(gold)
+    assert_same_results(got, gold)
+    assert eng.last_precision["active"] == "bf16"
+
+
+def test_resident_engine_bf16_matches_f32_and_golden():
+    rng = np.random.default_rng(9)
+    n, na = 600, 5
+    corpus = KNNInput(Params(n, 0, na),
+                      rng.integers(0, 4, n).astype(np.int32),
+                      rng.uniform(-10, 10, (n, na)),
+                      np.zeros(0, np.int32), np.zeros((0, na)))
+    q = rng.uniform(-10, 10, (7, na))
+    ks = np.array([1, 3, 8, 17, 32, 48, 5], np.int32)
+    served_b = ResidentEngine(corpus, EngineConfig(precision="bf16")) \
+        .solve_batch(q, ks)
+    served_f = ResidentEngine(corpus, EngineConfig(precision="f32")) \
+        .solve_batch(q, ks)
+    inp = KNNInput(Params(n, len(ks), na), corpus.labels,
+                   corpus.data_attrs, ks, q)
+    gold = knn_golden(inp)
+    assert format_results(served_b) == format_results(served_f) \
+        == format_results(gold)
+
+
+def test_env_kill_switch_and_force(monkeypatch):
+    """$DMLP_TPU_PRECISION: "f32" disarms a bf16 config; "bf16" arms a
+    default config. Either way the answers stay golden."""
+    inp = _case(888)
+    monkeypatch.setenv("DMLP_TPU_PRECISION", "f32")
+    eng = SingleChipEngine(_cfg("bf16"))
+    assert format_results(eng.run(inp)) == format_results(knn_golden(inp))
+    assert eng.last_precision["active"] == "f32"
+    monkeypatch.setenv("DMLP_TPU_PRECISION", "bf16")
+    eng2 = SingleChipEngine(_cfg("auto"))
+    assert format_results(eng2.run(inp)) == format_results(knn_golden(inp))
+    assert eng2.last_precision["active"] == "bf16"
+
+
+def test_fast_mode_never_runs_lowp():
+    """The bf16 pass is only sound with the f64 rescore behind it —
+    fast (non-exact) mode must pin the pass to f32."""
+    cfg = _cfg("bf16", exact=False)
+    assert cfg.resolve_precision() == "f32"

@@ -201,8 +201,9 @@ def test_prune_rung_allows_fused_kernel():
 
 
 def test_oom_degrades_prune_to_fused_byte_identical():
-    """The ladder's new top rung: a staging OOM on the pruned solve
-    steps prune -> fused (dense) and the answer is unchanged."""
+    """Staging OOMs on the pruned solve walk the ladder's top rungs
+    (lowp -> prune -> fused): two faults land on the dense fused rung
+    and the answer is unchanged."""
     from dmlp_tpu.resilience import inject, stats
     from dmlp_tpu.resilience.inject import FaultEntry, FaultSchedule
 
@@ -210,7 +211,7 @@ def test_oom_degrades_prune_to_fused_byte_identical():
     gold = format_results(knn_golden(inp))
     stats.reset()
     inject.install(FaultSchedule(
-        [FaultEntry("single.stage_put", "oom", times=1)]))
+        [FaultEntry("single.stage_put", "oom", times=2)]))
     try:
         eng = SingleChipEngine(EngineConfig(select="topk",
                                             data_block=256))
@@ -219,7 +220,8 @@ def test_oom_degrades_prune_to_fused_byte_identical():
         inject.uninstall()
     assert got == gold
     assert eng.last_degrade_rung == "fused"
-    assert "prune->fused" in stats.snapshot()["degradations"]
+    degs = stats.snapshot()["degradations"]
+    assert "lowp->prune" in degs and "prune->fused" in degs
     assert eng.last_prune["blocks_pruned"] == 0   # the fused rung is dense
 
 
@@ -319,3 +321,23 @@ def test_serve_prune_kill_switch(monkeypatch):
         _serve_golden(eng, q, ks)
     assert eng.last_prune["blocks_pruned"] == 0
     monkeypatch.delenv("DMLP_TPU_PRUNE")
+
+
+def test_split_lb_positive_fraction_on_uniform_corpus():
+    """Non-vacuity of the 2-piece split on the hardest corpus for it:
+    uniform data, where the whole-block boxes span the full cube and
+    every whole-block lower bound is provably 0. The half-cube pieces
+    must keep a strictly positive fraction of (query, live piece)
+    lower bounds — the meter that shows the split buys real pruning
+    information even when block-level pruning is hopeless."""
+    inp = _case(61, n=2048, nq=16, na=6)
+    ranges = [(i, i + 256) for i in range(0, 2048, 256)]
+    summ = osum.build_summaries(inp.data_attrs, ranges)
+    keep, stats = osum.prune_mask(inp.query_attrs, inp.ks, summ)
+    assert keep.all()                       # uniform: nothing prunable
+    assert stats["lb_positive_fraction"] > 0.0, stats
+    # the whole-block-only format really is vacuous here — the split's
+    # win is the difference
+    flat = osum.build_summaries(inp.data_attrs, ranges, pieces=1)
+    _, flat_stats = osum.prune_mask(inp.query_attrs, inp.ks, flat)
+    assert "lb_positive_fraction" not in flat_stats

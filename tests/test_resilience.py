@@ -327,17 +327,18 @@ def test_ladder_steps_down_per_oom():
 
     def solve(inp):
         seen.append(eng._degrade_rung)
-        if len(seen) < 5:
+        if len(seen) < 6:
             raise SimulatedResourceExhausted("RESOURCE_EXHAUSTED")
         return "answer"
 
     assert degrade.run_ladder(eng, None, solve) == "answer"
-    assert seen == ["prune", "fused", "tuned", "heuristic", "streaming"]
+    assert seen == ["lowp", "prune", "fused", "tuned", "heuristic",
+                    "streaming"]
     assert eng.last_degrade_rung == "streaming"
     assert eng._degrade_rung == "fused"       # restored after the run
     assert stats.snapshot()["degradations"] == \
-        ["prune->fused", "fused->tuned", "tuned->heuristic",
-         "heuristic->streaming"]
+        ["lowp->prune", "prune->fused", "fused->tuned",
+         "tuned->heuristic", "heuristic->streaming"]
 
 
 def test_ladder_propagates_non_oom():
@@ -393,11 +394,12 @@ def test_engine_recovers_transients_byte_identical():
     assert snap["retries"] >= 3 and snap["faults_injected"] == 3
 
 
-@pytest.mark.parametrize("times,rung", [(1, "fused"),
-                                        (2, "tuned"),
-                                        (3, "heuristic"),
-                                        (4, "streaming"),
-                                        (5, "host")])
+@pytest.mark.parametrize("times,rung", [(1, "prune"),
+                                        (2, "fused"),
+                                        (3, "tuned"),
+                                        (4, "heuristic"),
+                                        (5, "streaming"),
+                                        (6, "host")])
 def test_engine_ladder_byte_identical(times, rung):
     inp = _small_input()
     golden = format_results(knn_golden(inp))

@@ -51,7 +51,7 @@ def test_cache_roundtrip_write_reload_hit(tune_cache_path):
 def test_cache_file_is_schema_validated(tune_cache_path):
     VariantCache().save(tune_cache_path)
     doc = json.load(open(tune_cache_path))
-    assert doc["schema"] == 2
+    assert doc["schema"] == 3
     assert doc["kernel"] == "pallas_topk"
     VariantCache.validate_doc(doc)  # round-trips its own schema
 

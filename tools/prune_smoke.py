@@ -18,9 +18,10 @@ Four invariants, each a hard failure:
    ``prune_*`` family), so the scanned-bytes ledger series is scraped,
    not inferred.
 4. **Ladder recovery** — under a seeded ``oom`` schedule at the
-   staging site the solve must step the resilience ladder
-   ``prune -> fused`` (visible in the metrics resilience block) and
-   STILL produce byte-identical contract stdout.
+   staging site the solve must step the resilience ladder past the
+   pruned rung (``prune -> fused``, after the top ``lowp`` rung steps
+   first — visible in the metrics resilience block) and STILL produce
+   byte-identical contract stdout.
 
 With ``--record FILE`` the banded A/B also lands as a kind="prune"
 RunRecord (ledger series ``prune/configbanded/...``), the committed
@@ -177,12 +178,12 @@ def main(argv=None) -> int:
     print("prune_smoke: scan.bytes_streamed + prune.* visible in the "
           "OpenMetrics scrape")
 
-    # 4. ladder recovery: seeded oom at staging -> prune->fused, output
-    #    still byte-identical
+    # 4. ladder recovery: seeded ooms at staging walk the top rungs
+    #    (lowp -> prune -> fused), output still byte-identical
     sched_path = os.path.join(args.out, "oom_schedule.json")
     with open(sched_path, "w") as f:
         json.dump({"schema": 1, "seed": 3, "faults": [
-            {"site": "single.stage_put", "kind": "oom", "times": 1}]}, f)
+            {"site": "single.stage_put", "kind": "oom", "times": 2}]}, f)
     oom_metrics = os.path.join(args.out, "metrics_oom.jsonl")
     if os.path.exists(oom_metrics):
         os.remove(oom_metrics)
