@@ -8,8 +8,8 @@ CXXFLAGS ?= -O3 -Wall -shared -fPIC
 .PHONY: all native test tier1 bench obs-smoke obs-dist-smoke tune-smoke \
 	perf-gate check lint chaos-smoke telemetry-smoke serve-smoke \
 	race-smoke prune-smoke precision-smoke fleet-smoke \
-	fleet-chaos-smoke fleet-trace-smoke slo-smoke serve-bench \
-	fleet-bench clean
+	fleet-chaos-smoke fleet-trace-smoke slo-smoke auto-smoke \
+	serve-bench fleet-bench clean
 
 all: native
 
@@ -21,7 +21,7 @@ native/_fastparse.so: native/fastparse.cpp
 test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint \
 	chaos-smoke telemetry-smoke serve-smoke race-smoke prune-smoke \
 	precision-smoke fleet-smoke fleet-chaos-smoke fleet-trace-smoke \
-	slo-smoke
+	slo-smoke auto-smoke
 	python -m pytest tests/ -q
 
 # Static analysis + runtime-sanitizer smoke (README "Static analysis &
@@ -320,6 +320,43 @@ slo-smoke:
 	JAX_PLATFORMS=cpu python tools/slo_smoke.py \
 	  --out outputs/slo \
 	  --record outputs/slo/SLO_SMOKE.jsonl
+
+# Compiler-sharded engine smoke (README "Compiler-driven sharding &
+# persistent compile cache"): (1) the `--engine auto` CLI alias
+# end-to-end on bench input 1 — contract stdout byte-identical to the
+# default single-chip run (and hence to the f64 golden oracle the
+# bench step diffs below); (2) bench --auto-ab on config 1:
+# interleaved auto/sharded/ring arms with byte-identity asserted
+# before any timing enters the record and the warmup-compile split
+# broken out per arm; (3) the kind="auto" RunRecord round-trips the
+# perf ledger as a gated auto/config1/ series. The warm-relaunch
+# cold-start check (persistent compile cache) lives in
+# fleet-chaos-smoke campaign 4.
+auto-smoke:
+	mkdir -p outputs/auto
+	JAX_PLATFORMS=cpu python -c "from dmlp_tpu.bench.configs import BENCH_CONFIGS; \
+	from dmlp_tpu.bench.harness import ensure_input; \
+	ensure_input(BENCH_CONFIGS[1], 'inputs')"
+	JAX_PLATFORMS=cpu python -m dmlp_tpu < inputs/input1.in \
+	  > outputs/auto/single.out 2> /dev/null
+	JAX_PLATFORMS=cpu python -m dmlp_tpu --engine auto \
+	  < inputs/input1.in \
+	  > outputs/auto/auto.out 2> outputs/auto/auto.err
+	grep -q "Time taken:" outputs/auto/auto.err
+	cmp outputs/auto/single.out outputs/auto/auto.out
+	rm -f outputs/auto/AUTO_SMOKE.jsonl
+	JAX_PLATFORMS=cpu python -m dmlp_tpu.bench 1 --auto-ab --reps 2 \
+	  --metrics outputs/auto/AUTO_SMOKE.jsonl \
+	  | tee outputs/auto/bench.out
+	grep -q "byte-identical" outputs/auto/bench.out
+	JAX_PLATFORMS=cpu python -c "import sys; \
+	from dmlp_tpu.obs.ledger import ingest_file; \
+	e = ingest_file('outputs/auto/AUTO_SMOKE.jsonl'); \
+	assert e['status'] == 'parsed', e; \
+	s = {p['series'] for p in e['points']}; \
+	assert any(x.startswith('auto/config1/') for x in s), sorted(s); \
+	sys.path.insert(0, 'tools'); import perf_gate as pg; \
+	assert pg.gated('auto/config1/engine_ms_auto')"
 
 # Fleet SLO bench (not in `make test`; emits the FLEET_rNN ledger
 # rounds): 2 replicas (one mesh-resident) + router, the paced trace

@@ -289,6 +289,7 @@ def run_config(config_id: int, base_dir: str = ".",
                fused_ab: bool = False,
                prune_ab: bool = False,
                precision_ab: bool = False,
+               auto_ab: bool = False,
                telemetry_dir: Optional[str] = None) -> dict:
     """Full benchmark flow for one config; returns a result summary dict.
 
@@ -552,6 +553,25 @@ def run_config(config_id: int, base_dir: str = ".",
             from dmlp_tpu.obs.run import RunRecord, round_from_name
             RunRecord(kind="precision", tool="dmlp_tpu.bench",
                       config=_dc.asdict(cfg), metrics=dict(prec_res),
+                      device="cpu" if cpu_pinned else None,
+                      round=round_from_name(record_path)
+                      ).append_jsonl(record_path)
+    if auto_ab:
+        auto_res = _measure_auto_ab(
+            cfg, input_path, outputs_dir, out, fast=fast,
+            timeout_s=timeout_s, env=env, pairs=n_reps,
+            oracle_want=want if check_reps else None)
+        res.update(auto_res)
+        if record_path:
+            # A dedicated kind="auto" RunRecord so the compiler-vs-
+            # hand-rolled A/B lands in the ledger's ``auto/configN/...``
+            # family (gated by tools/perf_gate.py) alongside the plain
+            # bench record.
+            import dataclasses as _dc
+
+            from dmlp_tpu.obs.run import RunRecord, round_from_name
+            RunRecord(kind="auto", tool="dmlp_tpu.bench",
+                      config=_dc.asdict(cfg), metrics=dict(auto_res),
                       device="cpu" if cpu_pinned else None,
                       round=round_from_name(record_path)
                       ).append_jsonl(record_path)
@@ -1004,6 +1024,120 @@ def _measure_precision_ab(cfg: BenchConfig, input_path: str,
     return res
 
 
+def _measure_auto_ab(cfg: BenchConfig, input_path: str,
+                     outputs_dir: str, out: TextIO,
+                     fast: bool, timeout_s: float, env: Optional[dict],
+                     pairs: int, oracle_want: Optional[str]) -> dict:
+    """Interleaved compiler-sharded vs hand-rolled engine timings: the
+    GSPMD engine (``--mode auto``) against BOTH hand-written merges
+    (``--mode sharded`` all-gather, ``--mode ring``), arm order
+    alternating per rep (the repo's A/B weathering methodology). The
+    record carries:
+
+    - ``engine_ms_auto`` / ``engine_ms_sharded`` / ``engine_ms_ring``
+      medians plus raw ``*_reps`` lists (ledger per-trial evidence ->
+      a gated ``auto/configN/...`` series) and the headline
+      ``auto_ab_pct_vs_sharded`` / ``auto_ab_pct_vs_ring`` deltas;
+    - ``compile_ms_*``: each arm's ``warmup_compile`` phase (the
+      ``--warmup`` solve that pays XLA compilation, reported via
+      ``--phase-times``) — the compile-time side of the A/B, split out
+      so a GSPMD partitioner that searches longer for its schedule is
+      charged visibly rather than hidden in an untimed warmup;
+    - ``auto_ab_identical``: every arm's stdout byte-equal to every
+      other arm's (and the oracle in exact mode) — the auto engine's
+      core contract, CHECKED per run, not assumed. A mismatch
+      withholds the timings: a wrong-output arm must never become a
+      ledger point;
+    - ``auto_ab_degenerate_mesh``: honest marker when the config pins
+      no multi-device mesh — on a 1-device CPU container all three
+      arms compile 1x1-mesh programs with no cross-shard merge at
+      all, so the timings compare jit overheads, not collective
+      schedules (the TPU round owns the qualified claim).
+
+    Never raises: failures record ``auto_ab_unavailable``."""
+    import re as _re
+    import statistics
+
+    if cfg.procs > 1:
+        return {"auto_ab_unavailable": "multi-process config (the A/B "
+                "drives the single-process engine CLI)"}
+    arms = ("auto", "sharded", "ring")
+    times: dict = {a: [] for a in arms}
+    compile_ms: dict = {a: [] for a in arms}
+    outputs: dict = {a: set() for a in arms}
+    try:
+        for rep in range(max(pairs, 1)):
+            order = arms if rep % 2 == 0 else tuple(reversed(arms))
+            for arm in order:
+                out_path, err_path = run_engine(
+                    cfg, input_path, outputs_dir, mode=arm, fast=fast,
+                    timeout_s=timeout_s, env=env,
+                    obs_flags=["--phase-times"])
+                with open(out_path) as f:
+                    outputs[arm].add(f.read())
+                with open(err_path) as f:
+                    err_text = f.read()
+                ms = _extract_ms(err_text)
+                if ms is None:
+                    return {"auto_ab_unavailable":
+                            f"no timing line in the {arm}-arm run"}
+                times[arm].append(ms)
+                m = _re.search(r"phase warmup_compile:\s*([0-9.]+) ms",
+                               err_text)
+                if m:
+                    compile_ms[arm].append(round(float(m.group(1)), 1))
+    except (EngineTimeout, RuntimeError) as e:
+        return {"auto_ab_unavailable":
+                f"engine run failed during the A/B: {e}"}
+    identical = (all(len(outputs[a]) == 1 for a in arms)
+                 and outputs["auto"] == outputs["sharded"]
+                 == outputs["ring"]
+                 and (oracle_want is None
+                      or outputs["auto"] == {oracle_want}))
+    if not identical:
+        return {"auto_ab_unavailable":
+                "auto/sharded/ring stdout MISMATCH — byte-identity "
+                "contract violated; timings withheld",
+                "auto_ab_identical": False}
+    med = {a: statistics.median(times[a]) for a in arms}
+    res: dict = {"auto_ab_identical": True}
+    for a in arms:
+        res[f"engine_ms_{a}"] = round(med[a])
+        res[f"engine_ms_{a}_reps"] = times[a]
+        if compile_ms[a]:
+            res[f"compile_ms_{a}"] = round(
+                statistics.median(compile_ms[a]))
+            res[f"compile_ms_{a}_reps"] = compile_ms[a]
+    for rival in ("sharded", "ring"):
+        if med[rival] > 0:
+            res[f"auto_ab_pct_vs_{rival}"] = round(
+                (med["auto"] - med[rival]) / med[rival] * 100.0, 2)
+    if not cfg.virtual_devices or cfg.virtual_devices <= 1:
+        res["auto_ab_degenerate_mesh"] = True
+    else:
+        # The mesh is N virtual devices on ONE CPU: every delta here
+        # (notably GSPMD's partitioning/compile cost) measures the
+        # emulated platform, not a TPU slice's ICI schedule.
+        res["auto_ab_virtual_mesh_devices"] = cfg.virtual_devices
+    def _pct(rival: str) -> str:
+        v = res.get(f"auto_ab_pct_vs_{rival}")
+        return f"{v:+.1f}%" if v is not None else "n/a"
+
+    out.write(f"Config {cfg.config_id}: auto A/B {_pct('sharded')} vs "
+              f"sharded, {_pct('ring')} vs ring (medians sharded "
+              f"{res['engine_ms_sharded']} / ring "
+              f"{res['engine_ms_ring']} -> auto "
+              f"{res['engine_ms_auto']} ms over {len(times['auto'])} "
+              f"interleaved rep(s), compile "
+              f"{res.get('compile_ms_sharded', '?')} / "
+              f"{res.get('compile_ms_ring', '?')} -> "
+              f"{res.get('compile_ms_auto', '?')} ms, byte-identical"
+              + (", DEGENERATE 1x1 mesh"
+                 if res.get("auto_ab_degenerate_mesh") else "")
+              + ")\n")
+    return res
+
+
 def _append_run_record(record_path: str, cfg: BenchConfig, res: dict,
                        trace_dir: Optional[str],
                        profile: Optional[tuple] = None,
@@ -1273,7 +1407,7 @@ def main(argv=None) -> int:
                                   "replays --serve-trace against the "
                                   "resident daemon)")
     p.add_argument("--mode", default=None,
-                   choices=[None, "single", "sharded", "ring"])
+                   choices=[None, "single", "sharded", "ring", "auto"])
     p.add_argument("--fast", action="store_true",
                    help="drop the f64 host rescore (f32 ordering; checksum "
                         "diffs vs the f64 oracle are then expected)")
@@ -1337,6 +1471,16 @@ def main(argv=None) -> int:
                         "kcap window inflation (+ raw rep lists) as a "
                         "kind=\"precision\" RunRecord per config "
                         "(single-process configs)")
+    p.add_argument("--auto-ab", action="store_true",
+                   help="A/B the compiler-sharded engine: run "
+                        "interleaved --mode auto / sharded / ring "
+                        "engine arms, verify all three byte-identical "
+                        "(and vs the oracle in exact mode), and record "
+                        "engine_ms_auto / engine_ms_sharded / "
+                        "engine_ms_ring plus each arm's "
+                        "warmup-compile split (+ raw rep lists) as a "
+                        "kind=\"auto\" RunRecord per config "
+                        "(single-process configs)")
     p.add_argument("--serve-trace", metavar="FILE", default=None,
                    help="recorded query trace for the serve mode "
                         "(default inputs/serve_trace1.jsonl)")
@@ -1370,6 +1514,7 @@ def main(argv=None) -> int:
                          fused_ab=args.fused_ab,
                          prune_ab=args.prune_ab,
                          precision_ab=args.precision_ab,
+                         auto_ab=args.auto_ab,
                          telemetry_dir=args.telemetry_dir)
         # `timed_out` is a marker, not a verdict (markers never gate):
         # the config's RunRecord documents the hang; a wrong checksum

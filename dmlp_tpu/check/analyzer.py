@@ -26,9 +26,10 @@ from dmlp_tpu.check.common import ModuleInfo
 from dmlp_tpu.check.facts import PackageFacts, module_facts
 from dmlp_tpu.check.findings import Finding
 
-ALL_FAMILIES = ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
+ALL_FAMILIES = ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8",
+                "R9")
 #: families make check enforces by default; R0 rides in `make lint`
-DEFAULT_FAMILIES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
+DEFAULT_FAMILIES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9")
 
 
 def package_root() -> str:
@@ -84,6 +85,7 @@ def load_modules(paths: Sequence[str], root: Optional[str] = None
 
 def build_rules(facts: PackageFacts,
                 families: Optional[Sequence[str]] = None) -> list:
+    from dmlp_tpu.check.autoshard import AutoShardRule
     from dmlp_tpu.check.compatrule import CompatRule
     from dmlp_tpu.check.concurrency import ConcurrencyRule
     from dmlp_tpu.check.collectives import CollectiveRule
@@ -116,6 +118,8 @@ def build_rules(facts: PackageFacts,
         rules.append(ConcurrencyRule(facts.concurrency))
     if "R8" in fams:
         rules.append(LowPrecRule(facts))
+    if "R9" in fams:
+        rules.append(AutoShardRule(facts))
     return rules
 
 

@@ -1,0 +1,117 @@
+"""R9 — the compiler-sharded (GSPMD) surface contract.
+
+R1 guards the hand-rolled collectives; this family extends the same
+axis discipline to the declarative sharding surface the auto engine
+(engine/auto.py) introduced:
+
+- **R901**: every axis a ``PartitionSpec`` (usually spelled ``P``)
+  names — and hence every ``NamedSharding`` / ``with_sharding_constraint``
+  built from it — must resolve to a mesh axis some ``*_AXIS`` constant
+  in the package declares. A typo'd axis in a sharding spec is worse
+  than R101's psum case: GSPMD silently replicates instead of sharding,
+  so the program is CORRECT and slow — nothing ever fails.
+- **R902**: ``jax.jit`` calls in ``engine/auto.py`` must pin BOTH
+  ``in_shardings`` and ``out_shardings`` (or carry ``# check:
+  allow-auto-shard``): the auto engine's whole claim is that the partitioner
+  sees the full placement contract, not whatever it infers from the
+  first dispatch's committed layouts — an unpinned jit there can
+  benchmark a different (resharding-on-entry) program than the one the
+  A/B record names.
+
+Axis expressions resolve exactly like R1 (``check.collectives
+.resolve_axis``): string literals, ``*_AXIS`` constants (local or
+imported), tuples of those; function parameters and opaque expressions
+are skipped, not guessed at. ``None`` spec entries are replication, not
+axes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set
+
+from dmlp_tpu.check.collectives import resolve_axis
+from dmlp_tpu.check.common import ModuleInfo, call_name
+from dmlp_tpu.check.findings import Finding
+
+ALLOW = "allow-auto-shard"
+
+#: the one file whose jits carry the R902 pinning contract
+AUTO_ENGINE_PATH = "dmlp_tpu/engine/auto.py"
+
+
+def _is_pspec_call(call: ast.Call, mod: ModuleInfo) -> bool:
+    """Is this a PartitionSpec construction? Covers the canonical
+    ``P`` alias by resolving the name through the module's imports
+    (``from jax.sharding import PartitionSpec as P``)."""
+    name = call_name(call)
+    if name is None:
+        return False
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf == "PartitionSpec":
+        return True
+    src = mod.imports.get(leaf, "")
+    return src.rsplit(".", 1)[-1] == "PartitionSpec"
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    name = call_name(call)
+    return name in ("jax.jit", "jit")
+
+
+class AutoShardRule:
+    """One instance runs over the whole package; declared axes come
+    from the merged PackageFacts (same source R1 reads)."""
+
+    def __init__(self, facts):
+        self.axis_consts: Dict[str, str] = facts.axis_consts
+        self.declared: Set[str] = facts.declared
+
+    def run(self, mod: ModuleInfo, add) -> None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_pspec_call(node, mod):
+                self._check_spec_axes(mod, node, add)
+            elif _is_jit_call(node) \
+                    and mod.relpath.replace("\\", "/") == AUTO_ENGINE_PATH:
+                self._check_jit_pinning(mod, node, add)
+
+    def _check_spec_axes(self, mod: ModuleInfo, node: ast.Call,
+                         add) -> None:
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and arg.value is None:
+                continue    # replication entry, not an axis
+            resolved = resolve_axis(arg, mod, self.axis_consts)
+            if resolved is None or (isinstance(resolved, tuple)
+                                    and resolved[0] == "param"):
+                continue    # opaque / parameter-passed: not guessed at
+            axes = resolved if isinstance(resolved, list) else [resolved]
+            for ax in axes:
+                if ax in self.declared:
+                    continue
+                if mod.allowed_value(node, ALLOW, "R901"):
+                    continue
+                add(Finding(
+                    "R901", mod.relpath, node.lineno, node.col_offset,
+                    mod.scope_of(node), f"pspec:{ax}",
+                    f"PartitionSpec names mesh axis {ax!r}, which no "
+                    f"*_AXIS constant declares (declared: "
+                    f"{sorted(self.declared)}) — GSPMD would silently "
+                    f"replicate instead of sharding"))
+
+    def _check_jit_pinning(self, mod: ModuleInfo, node: ast.Call,
+                           add) -> None:
+        kwargs = {kw.arg for kw in node.keywords}
+        missing = sorted({"in_shardings", "out_shardings"} - kwargs)
+        if not missing:
+            return
+        if mod.allowed_value(node, ALLOW, "R902"):
+            return
+        add(Finding(
+            "R902", mod.relpath, node.lineno, node.col_offset,
+            mod.scope_of(node), f"jit:{','.join(missing)}",
+            f"jit in the auto engine must pin in_shardings/"
+            f"out_shardings (missing {missing}) or carry "
+            f"`# check: allow-auto-shard` — an unpinned jit lets "
+            f"the partitioner infer placements from the first dispatch"))

@@ -76,6 +76,13 @@ RULES = {
             " annotation naming its analytic error bound",
     "R803": "lowp-eps annotation names a function engine/finalize.py"
             " does not define",
+    # R9 — compiler-sharded (GSPMD) surface contract (engine/auto.py)
+    "R901": "PartitionSpec names a mesh axis no *_AXIS constant"
+            " declares (GSPMD silently replicates instead of sharding)",
+    "R902": "jit in engine/auto.py without pinned in_shardings/"
+            "out_shardings (the partitioner must see the full"
+            " placement contract, not infer it from the first"
+            " dispatch)",
 }
 
 #: rule id -> allowlist directive that silences it at a call site.
@@ -89,6 +96,7 @@ ALLOW_DIRECTIVES = {
     "R6": "allow-metric-name",
     "R7": "allow-concurrency",
     "R8": "allow-lowprec",
+    "R9": "allow-auto-shard",
 }
 
 #: every directive that SUPPRESSES a finding (for ``--stale-allows``):

@@ -15,8 +15,9 @@ summary to stderr after the contract line.
 
 Usage::
 
-    python -m dmlp_tpu [--mode single|sharded|ring] [--debug] [--fast]
-                       [--engine jax|golden] [--phase-times]
+    python -m dmlp_tpu [--mode single|sharded|ring|auto] [--debug] [--fast]
+                       [--engine jax|golden|auto] [--phase-times]
+                       [--compile-cache DIR]
                        [--trace FILE] [--metrics FILE] [--counters] < input.in
 """
 
@@ -60,9 +61,11 @@ def make_engine(config: EngineConfig, stderr=None):
     if config.mode == "single":
         from dmlp_tpu.engine.single import SingleChipEngine
         return SingleChipEngine(config)
-    if config.mode in ("sharded", "ring"):
+    if config.mode in ("sharded", "ring", "auto"):
         if config.mode == "sharded":
             from dmlp_tpu.engine.sharded import ShardedEngine as cls
+        elif config.mode == "auto":
+            from dmlp_tpu.engine.auto import AutoShardedEngine as cls
         else:
             from dmlp_tpu.engine.ring import RingEngine as cls
         if config.mesh_shape is not None:
@@ -171,14 +174,21 @@ def main(argv: Optional[Sequence[str]] = None,
          stderr: Optional[IO] = None) -> int:
     parser = argparse.ArgumentParser(prog="dmlp_tpu", description=__doc__)
     parser.add_argument("--mode", default="single",
-                        choices=["single", "sharded", "ring"])
+                        choices=["single", "sharded", "ring", "auto"],
+                        help="engine: 'auto' is the compiler-sharded "
+                             "(GSPMD) engine — pure jit + NamedSharding "
+                             "constraints instead of hand-rolled "
+                             "collectives (engine.auto)")
     parser.add_argument("--mesh", default=None, metavar="R,C",
                         help="mesh shape (data x query axes) for the "
-                             "sharded/ring engines; default auto-factorizes "
-                             "all devices (MPI_Dims_create analog)")
-    parser.add_argument("--engine", default="jax", choices=["jax", "golden"],
+                             "sharded/ring/auto engines; default "
+                             "auto-factorizes all devices "
+                             "(MPI_Dims_create analog)")
+    parser.add_argument("--engine", default="jax",
+                        choices=["jax", "golden", "auto"],
                         help="'golden' runs the NumPy oracle (differential "
-                             "testing reference)")
+                             "testing reference); 'auto' is shorthand for "
+                             "the jax engine with --mode auto")
     parser.add_argument("--debug", action="store_true",
                         help="human-readable output (the -DDEBUG build)")
     parser.add_argument("--fast", action="store_true",
@@ -220,6 +230,11 @@ def main(argv: Optional[Sequence[str]] = None,
                         help="run the solve once untimed first, so the "
                              "timed region excludes XLA compilation (the "
                              "reference engine pays no JIT)")
+    parser.add_argument("--compile-cache", metavar="DIR", default=None,
+                        help="persistent XLA compilation cache dir (best "
+                             "effort; later runs reuse on-disk "
+                             "executables); $DMLP_TPU_COMPILE_CACHE is "
+                             "the ambient form (flag wins)")
     parser.add_argument("--sanitize", action="store_true",
                         help="wrap the solve in "
                              "jax.transfer_guard('disallow') + "
@@ -301,6 +316,12 @@ def main(argv: Optional[Sequence[str]] = None,
 
 def _run_cli(parser, args, stdin, stdout, stderr, tracer, probe) -> int:
     mesh_shape = parse_mesh_arg(parser, args.mesh)
+    from dmlp_tpu.utils.compile_cache import enable_from_flag
+    enable_from_flag(args.compile_cache)  # before any compile
+    if args.engine == "auto":
+        # --engine auto == the jax engine with the compiler-sharded
+        # mode; keep the summary-record fields consistent.
+        args.engine, args.mode = "jax", "auto"
     config = EngineConfig(mode=args.mode, debug=args.debug,
                           exact=not args.fast, data_block=args.data_block,
                           query_block=args.query_block, dtype=args.dtype,

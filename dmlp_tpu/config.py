@@ -18,12 +18,15 @@ class EngineConfig:
     """Configuration for the KNN engines.
 
     Attributes:
-      mode: "single" | "sharded" | "ring" — which engine to run.
-        "single" is the one-chip engine; "sharded" is the 2D-mesh
+      mode: "single" | "sharded" | "ring" | "auto" — which engine to
+        run. "single" is the one-chip engine; "sharded" is the 2D-mesh
         all-gather-merge engine (analog of the reference's grid +
         MPI_Gather merge, engine.cpp:40-57,282-308); "ring" streams data
         shards around the mesh ring with a running top-k (the
-        long-context / memory-bounded variant).
+        long-context / memory-bounded variant); "auto" is the
+        compiler-sharded engine (engine.auto): the same solve as pure
+        jit + NamedSharding constraints, with GSPMD choosing the
+        collective schedule instead of the hand-written merges.
       mesh_shape: (data_axis_size, query_axis_size). None = auto from
         available devices (mirrors MPI_Dims_create at engine.cpp:41).
       data_block: data points processed per inner step on one chip.
@@ -101,7 +104,7 @@ class EngineConfig:
     precision: str = "auto"
 
     def __post_init__(self) -> None:
-        if self.mode not in ("single", "sharded", "ring"):
+        if self.mode not in ("single", "sharded", "ring", "auto"):
             raise ValueError(f"unknown mode {self.mode!r}")
         if self.dtype not in ("auto", "float32", "bfloat16"):
             raise ValueError(f"unsupported dtype {self.dtype!r}")

@@ -1,3 +1,4 @@
 from dmlp_tpu.engine.single import SingleChipEngine  # noqa: F401
 from dmlp_tpu.engine.sharded import RingEngine, ShardedEngine  # noqa: F401
+from dmlp_tpu.engine.auto import AutoShardedEngine  # noqa: F401
 from dmlp_tpu.engine.finalize import finalize_host  # noqa: F401

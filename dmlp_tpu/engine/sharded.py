@@ -42,7 +42,7 @@ from dmlp_tpu.obs import counters as obs_counters
 from dmlp_tpu.obs import memwatch, telemetry
 from dmlp_tpu.obs.comms import engine_comms
 from dmlp_tpu.obs.trace import span as obs_span
-from dmlp_tpu.ops.topk import TopK, streaming_topk
+from dmlp_tpu.ops.topk import TopK, select_topk, streaming_topk
 from dmlp_tpu.parallel.collectives import allgather_merge_topk, ring_allreduce_topk
 from dmlp_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS, make_mesh
 from dmlp_tpu.resilience import inject as rs_inject
@@ -250,6 +250,51 @@ class ShardedEngine:
             merge = self._merge_strategy
             solve_shard = self._solve_shard_fn(k, data_block, select, impl,
                                                precision)
+            if merge == "gspmd":
+                # Compiler-scheduled merged program (merge="auto"): the
+                # same per-shard fold vmapped over a data-sharded 3D
+                # view, merge point spelled as a data->query reshard
+                # constraint instead of an explicit collective (mirrors
+                # engine.auto._fn_auto; _plan_shard never plans
+                # "extract" here, so solve_shard is a streaming fold).
+                mesh = self.mesh
+                r, c = mesh.devices.shape
+                d3 = NamedSharding(mesh, P(DATA_AXIS, None, None))
+                d2 = NamedSharding(mesh, P(DATA_AXIS, None))
+                d1 = NamedSharding(mesh, P(DATA_AXIS))
+                qsh = NamedSharding(mesh, P(QUERY_AXIS, None))
+                ish = NamedSharding(mesh, P(DATA_AXIS, QUERY_AXIS))
+
+                def merged(data_a, data_l, data_i, q_attrs):
+                    sr = data_a.shape[0] // r
+                    a3 = jax.lax.with_sharding_constraint(
+                        data_a.reshape(r, sr, data_a.shape[1]), d3)
+                    l2 = jax.lax.with_sharding_constraint(
+                        data_l.reshape(r, sr), d2)
+                    i2 = jax.lax.with_sharding_constraint(
+                        data_i.reshape(r, sr), d2)
+                    tops, its = jax.vmap(
+                        lambda a, lab, ids: solve_shard(
+                            a, lab, ids, q_attrs))(a3, l2, i2)
+                    qpad = q_attrs.shape[0]
+                    md = jnp.moveaxis(tops.dists, 0, 1).reshape(qpad, -1)
+                    ml = jnp.moveaxis(tops.labels, 0, 1).reshape(qpad, -1)
+                    mi = jnp.moveaxis(tops.ids, 0, 1).reshape(qpad, -1)
+                    md = jax.lax.with_sharding_constraint(md, qsh)
+                    ml = jax.lax.with_sharding_constraint(ml, qsh)
+                    mi = jax.lax.with_sharding_constraint(mi, qsh)
+                    top = select_topk(md, ml, mi, k)
+                    # (R, C) iters matching the shard_map out_spec shape;
+                    # streaming folds report zero, so the column
+                    # replication cannot overcount a measured term.
+                    its_rc = jnp.broadcast_to(its.reshape(r, 1), (r, c))
+                    return top, jax.lax.with_sharding_constraint(
+                        its_rc, ish)
+
+                self._fns[key] = jax.jit(
+                    merged, in_shardings=(d2, d1, d1, qsh),
+                    out_shardings=(TopK(qsh, qsh, qsh), ish))
+                return self._fns[key]
 
             def local(data_a, data_l, data_i, q_attrs):
                 top, its = solve_shard(data_a, data_l, data_i, q_attrs)
@@ -370,6 +415,33 @@ class ShardedEngine:
         key = ("chunkmerge", k, self._merge_strategy)
         if key not in self._fns:
             merge = self._merge_strategy
+            if merge == "gspmd":
+                # Compiler-scheduled variant (the auto engine's merge
+                # point, reachable here through MeshResidentEngine
+                # merge="auto"): collapse the shard axis into the
+                # candidate axis and constrain the result onto the query
+                # axis — GSPMD schedules the data->query reshard the
+                # shard_map branch below spells out by hand. Same
+                # composite re-select, so the selection order matches.
+                csh3 = NamedSharding(self.mesh,
+                                     P(DATA_AXIS, QUERY_AXIS, None))
+                rsh = NamedSharding(self.mesh, P())
+                qsh = NamedSharding(self.mesh, P(QUERY_AXIS, None))
+
+                def merged(cd, ci, lab_g):
+                    qpad = cd.shape[1]
+                    md = jnp.moveaxis(cd, 0, 1).reshape(qpad, -1)
+                    mi = jnp.moveaxis(ci, 0, 1).reshape(qpad, -1)
+                    ml = _labels_for_ids(mi, lab_g)
+                    md = jax.lax.with_sharding_constraint(md, qsh)
+                    ml = jax.lax.with_sharding_constraint(ml, qsh)
+                    mi = jax.lax.with_sharding_constraint(mi, qsh)
+                    return select_topk(md, ml, mi, k)
+
+                self._fns[key] = jax.jit(
+                    merged, in_shardings=(csh3, csh3, rsh),
+                    out_shardings=TopK(qsh, qsh, qsh))
+                return self._fns[key]
 
             def local(cd, ci, lab_g):
                 ids = ci[0]
@@ -850,7 +922,11 @@ class ShardedEngine:
         r, c = self.mesh.devices.shape
         shard_rows = d_attrs.shape[0] // r
         cap = shard_rows * r if merged_width else shard_rows
-        if cfg.data_block is None \
+        # The gspmd merged program (merge="auto") streams with the XLA
+        # selects only: a Pallas dispatch inside a GSPMD-partitioned jit
+        # would need its own partitioning rules — exactly the
+        # hand-rolling that strategy exists to avoid (engine.auto).
+        if self._merge_strategy != "gspmd" and cfg.data_block is None \
                 and cfg.resolve_select(shard_rows) == "extract":
             from dmlp_tpu.ops.pallas_extract import supports as ex_supports
             k = resolve_kcap(cfg, kmax, "extract", cap,

@@ -67,7 +67,8 @@ class ReplicaSpec:
                  warm_spec: str = "1x1", batch_cap: int = 32,
                  flags: Optional[List[str]] = None,
                  env_extra: Optional[Dict[str, str]] = None,
-                 capacity: Optional[int] = None):
+                 capacity: Optional[int] = None,
+                 compile_cache: Optional[str] = None):
         self.corpus_path = os.path.abspath(corpus_path)
         self.out_dir = os.path.abspath(out_dir)
         self.warm_spec = warm_spec
@@ -75,6 +76,14 @@ class ReplicaSpec:
         self.flags = list(flags or [])
         self.env_extra = dict(env_extra or {})
         self.capacity = capacity
+        # Shared persistent XLA compile cache for every spawn from this
+        # template: relaunches, scale-ups, and capacity re-splits all
+        # reuse the first generation's executables (their bucket shapes
+        # are identical by construction), so the fleet's cold-start
+        # compile time pays once. $DMLP_TPU_COMPILE_CACHE is the
+        # ambient form (inherited env) when no explicit dir is given.
+        self.compile_cache = (os.path.abspath(compile_cache)
+                              if compile_cache else None)
 
     def _env(self) -> Dict[str, str]:
         env = dict(self.env_extra)
@@ -100,7 +109,8 @@ class ReplicaSpec:
         return fh.spawn_replica(self.corpus_path, self.out_dir, name,
                                 self.warm_spec,
                                 batch_cap=self.batch_cap, flags=flags,
-                                env_extra=self._env())
+                                env_extra=self._env(),
+                                compile_cache=self.compile_cache)
 
 
 class ManagedReplica:

@@ -47,7 +47,8 @@ def spawn_replica(corpus_path: str, out_dir: str, name: str,
                   warm_spec: str, batch_cap: int = 32,
                   flags: Optional[List[str]] = None,
                   env_extra: Optional[Dict[str, str]] = None,
-                  record: Optional[str] = None) -> FleetProc:
+                  record: Optional[str] = None,
+                  compile_cache: Optional[str] = None) -> FleetProc:
     ready = os.path.join(out_dir, f"{name}_ready.json")
     telem = os.path.join(out_dir, f"{name}_telemetry.prom")
     errlog = os.path.join(out_dir, f"{name}.err")
@@ -62,6 +63,11 @@ def spawn_replica(corpus_path: str, out_dir: str, name: str,
            "--tick-ms", "2"] + (flags or [])
     if record:
         cmd += ["--record", record]
+    if compile_cache:
+        # First-class form; $DMLP_TPU_COMPILE_CACHE also rides the
+        # inherited environment (_repo_env copies os.environ), so a
+        # harness can warm a whole replica tree either way.
+        cmd += ["--compile-cache", compile_cache]
     with open(errlog, "w") as ef:
         proc = subprocess.Popen(cmd, stderr=ef,
                                 stdout=subprocess.DEVNULL,

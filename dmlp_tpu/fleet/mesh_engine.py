@@ -98,14 +98,18 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
     the daemon's batcher/admission surface (``solve_batch``/``ingest``/
     ``warmup``/``bucket_plan``/``bucket_stats``); ``mesh_shape`` (or an
     explicit ``mesh``) picks the 2D grid, ``merge`` the candidate-merge
-    collective ("allgather" | "ring").
+    collective ("allgather" | "ring" | "auto" — "auto" hands the
+    cross-shard merge to the GSPMD partitioner via the engines' "gspmd"
+    chunk-merge program; no analytic comms model, see obs.comms).
     """
 
     def __init__(self, corpus: KNNInput, config: EngineConfig = None,
                  mesh=None, mesh_shape: Optional[Tuple[int, int]] = None,
                  capacity: Optional[int] = None,
                  merge: str = "allgather", gate_carry: bool = True):
-        if merge not in ("allgather", "ring"):
+        if merge == "auto":
+            merge = "gspmd"     # the engine-internal strategy name
+        if merge not in ("allgather", "ring", "gspmd"):
             raise ValueError(f"unknown merge strategy {merge!r}")
         cfg = config or EngineConfig(mode="sharded")
         if mesh is None:

@@ -231,9 +231,13 @@ def engine_comms(merge_strategy: str, mesh_shape, q_local: int,
     dispatched: the (r, c) mesh runs one cross-shard merge per query-axis
     column over data-axis groups of r cells, each cell holding a
     (q_local, k) candidate triple. Single-chip solves dispatch no
-    collectives — an empty list, deliberately explicit."""
+    collectives — an empty list, deliberately explicit. The "gspmd"
+    strategy (auto engine / merge="auto") is ALSO empty: the compiler
+    chooses the schedule, so there is no hand-rolled collective to
+    model — claiming allgather traffic there would assert bytes the
+    program may never move."""
     r, c = mesh_shape
-    if r <= 1:
+    if r <= 1 or merge_strategy == "gspmd":
         return []
     fn = (ring_topk_traffic if merge_strategy == "ring"
           else allgather_topk_traffic)

@@ -291,6 +291,13 @@ def _runrecord_series_name(rec: RunRecord, key: str) -> str:
         # tools/perf_gate.py).
         cfg_tag = f"/config{cid}" if cid is not None else ""
         return f"precision{cfg_tag}/{key}"
+    if rec.kind == "auto":
+        # Compiler-sharded vs hand-rolled A/B records (bench --auto-ab,
+        # make auto-smoke): one ``auto/`` family regardless of emitter
+        # so the per-arm engine times and warmup-compile splits stay
+        # round-comparable (gated by tools/perf_gate.py).
+        cfg_tag = f"/config{cid}" if cid is not None else ""
+        return f"auto{cfg_tag}/{key}"
     if rec.tool == "dmlp_tpu.bench" and cid is not None:
         return f"harness/config{cid}/{key}"
     if rec.kind == "telemetry":

@@ -243,7 +243,10 @@ def mesh_engine_model(n: int, nq: int, na: int, kmax: int,
         "labels_ids_shard": shard_rows * 8,
         "query_shard": q_local * na * item,
         "local_topk": q_local * kc * _TOPK_ITEMSIZE,
-        "merge_buffer": (r if mode == "sharded" else 2)
+        # mode="ring" keeps the O(k) accumulator; "sharded" (allgather)
+        # materializes all r lists, and "auto" (GSPMD) prices that
+        # worst case — the compiler may pick it.
+        "merge_buffer": (2 if mode == "ring" else r)
         * q_local * kc * _TOPK_ITEMSIZE,
     }
     return _finish(terms, mode=mode, mesh=[r, c], kcap=kc,
@@ -351,7 +354,11 @@ def fleet_engine_model(mesh_shape, shard_rows: int, na: int,
     if qloc:
         terms["query_shard"] = qloc * na * item
         terms["local_topk"] = qloc * kcap * _TOPK_ITEMSIZE
-        terms["merge_buffer"] = (r if merge == "allgather" else 2) \
+        # Ring keeps the O(k) accumulator; allgather materializes all R
+        # lists. "gspmd" (merge="auto") prices the allgather worst case:
+        # the compiler may choose it, and the admission controller must
+        # not under-budget on a schedule it cannot see.
+        terms["merge_buffer"] = (2 if merge == "ring" else r) \
             * qloc * kcap * _TOPK_ITEMSIZE
     return _finish(terms, kind="fleet", mesh=[r, c],
                    shard_rows=shard_rows, staging=staging,
@@ -398,7 +405,9 @@ def model_for_engine(engine, inp) -> Dict[str, Any]:
         return single_engine_model(p.num_data, p.num_queries, p.num_attrs,
                                    kmax, config=engine.config,
                                    staging=engine._staging)
-    mode = "ring" if type(engine).__name__ == "RingEngine" else "sharded"
+    mode = {"RingEngine": "ring",
+            "AutoShardedEngine": "auto"}.get(
+        type(engine).__name__, "sharded")
     return mesh_engine_model(p.num_data, p.num_queries, p.num_attrs,
                              kmax, tuple(engine.mesh.devices.shape),
                              mode=mode, config=engine.config,

@@ -93,12 +93,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "(dmlp_tpu.fleet.mesh_engine; per-shard "
                         "resident buffers, allgather/ring merge as the "
                         "micro-batch epilogue)")
-    p.add_argument("--mesh-merge", choices=["allgather", "ring"],
+    p.add_argument("--mesh-merge",
+                   choices=["allgather", "ring", "auto"],
                    default="allgather",
-                   help="candidate-merge collective for --mesh")
+                   help="candidate-merge collective for --mesh "
+                        "('auto' hands the cross-shard merge to the "
+                        "GSPMD partitioner — engine.auto's merge "
+                        "point as the micro-batch epilogue)")
     p.add_argument("--compile-cache", metavar="DIR", default=None,
                    help="persistent XLA compilation cache dir (best "
-                        "effort; restarts then reuse executables)")
+                        "effort; restarts then reuse executables); "
+                        "$DMLP_TPU_COMPILE_CACHE is the ambient form "
+                        "(flag wins)")
     p.add_argument("--telemetry", metavar="FILE", default=None)
     p.add_argument("--telemetry-port", type=int, default=None,
                    metavar="PORT")
@@ -142,10 +148,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from dmlp_tpu.io.grammar import parse_input
     from dmlp_tpu.resilience import inject as rs_inject
     from dmlp_tpu.serve.daemon import ServeDaemon
-    from dmlp_tpu.serve.engine import enable_persistent_compile_cache
+    from dmlp_tpu.utils.compile_cache import enable_from_flag
 
-    if args.compile_cache:
-        enable_persistent_compile_cache(args.compile_cache)
+    # --compile-cache wins; $DMLP_TPU_COMPILE_CACHE is the ambient form
+    # (fleet harnesses warm a whole replica tree through the env).
+    enable_from_flag(args.compile_cache)
     budget = None
     if args.hbm_budget != "auto":
         budget = int(args.hbm_budget)

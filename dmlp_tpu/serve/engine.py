@@ -1088,18 +1088,8 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
         }
 
 
-def enable_persistent_compile_cache(directory: str) -> bool:
-    """Best-effort ``jax_compilation_cache_dir`` opt-in (the persistent
-    compilation cache, when this jax build ships it): process restarts
-    then reuse on-disk XLA executables, shrinking the cold-start number
-    the warm-up records. Returns True when enabled."""
-    try:
-        jax.config.update("jax_compilation_cache_dir", directory)
-        try:
-            jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                              0.0)
-        except Exception:  # check: no-retry — older knob spelling only
-            pass
-        return True
-    except Exception:  # check: no-retry — cache is an optimization only
-        return False
+# Hoisted to utils.compile_cache (the batch CLI, train loop, and fleet
+# spawn paths need the same opt-in); re-exported here so serve embedders
+# and `serve/__main__.py` keep importing it from this module unchanged.
+from dmlp_tpu.utils.compile_cache import (  # noqa: E402,F401
+    enable_persistent_compile_cache)

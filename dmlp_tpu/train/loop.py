@@ -10,7 +10,7 @@ dp x tp x pp pipelined stack, or the dp x ep MoE. Usage::
         --dims 64,512,512,10 [--mesh DP,TP] [--optimizer sgd|adam]
         [--compute-dtype bfloat16] [--offload [none|params|all]]
         [--checkpoint-dir ckpt --ckpt-every 100] [--resume]
-        [--metrics-file metrics.jsonl]
+        [--metrics-file metrics.jsonl] [--compile-cache DIR]
     python -m dmlp_tpu.train.loop --parallelism dp_pp  --mesh 2,4 \
         --dims 64,256,10 --microbatches 8
     python -m dmlp_tpu.train.loop --parallelism dp_pp3 --mesh 1,2,4 \
@@ -559,8 +559,16 @@ def main(argv=None) -> int:
                         "in HBM (half the stream bytes of 'all'); bare "
                         "--offload means 'all' (the bench_4 host-offload "
                         "analog)")
+    p.add_argument("--compile-cache", metavar="DIR", default=None,
+                   help="persistent XLA compilation cache dir (best "
+                        "effort; re-runs at the same shapes skip the "
+                        "step-function compiles); "
+                        "$DMLP_TPU_COMPILE_CACHE is the ambient form "
+                        "(flag wins)")
     args = p.parse_args(argv)
 
+    from dmlp_tpu.utils.compile_cache import enable_from_flag
+    enable_from_flag(args.compile_cache)
     mesh_shape = None
     if args.mesh:
         mesh_shape = tuple(int(d) for d in args.mesh.split(","))

@@ -1026,6 +1026,71 @@ class TestR7Concurrency:
 
 
 # ---------------------------------------------------------------------------
+# R9 — compiler-sharded (GSPMD) surface contract
+# ---------------------------------------------------------------------------
+
+
+class TestR9AutoShard:
+    def test_r901_undeclared_pspec_axis_caught(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            def shardings(mesh):
+                return NamedSharding(mesh, P("dataa", None))
+        """)
+        fs = run_check(tmp_path, ["R9"])
+        assert "R901" in rules_of(fs)
+        assert any("dataa" in f.message for f in fs)
+
+    def test_r901_declared_axes_and_none_entries_clean(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from dmlp_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS
+            def shardings(mesh):
+                return (NamedSharding(mesh, P(DATA_AXIS, None, None)),
+                        NamedSharding(mesh, P(QUERY_AXIS, None)),
+                        NamedSharding(mesh, P()))
+        """)
+        assert run_check(tmp_path, ["R9"]) == []
+
+    def test_r901_allow_directive_respected(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/engine/x.py", """
+            from jax.sharding import PartitionSpec as P
+            def spec():
+                # check: allow-auto-shard=R901 — doc example axis
+                return P("stage")
+        """)
+        assert run_check(tmp_path, ["R9"]) == []
+
+    def test_r902_unpinned_jit_in_auto_engine_caught(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/engine/auto.py", """
+            import jax
+            def build(fn):
+                return jax.jit(fn)
+        """)
+        fs = run_check(tmp_path, ["R9"])
+        assert "R902" in rules_of(fs)
+        assert any("in_shardings" in f.message for f in fs)
+
+    def test_r902_pinned_jit_clean_and_other_files_exempt(self, tmp_path):
+        write(tmp_path, "dmlp_tpu/parallel/mesh.py", MESH_SRC)
+        write(tmp_path, "dmlp_tpu/engine/auto.py", """
+            import jax
+            def build(fn, ins, outs):
+                return jax.jit(fn, in_shardings=ins, out_shardings=outs)
+        """)
+        write(tmp_path, "dmlp_tpu/engine/other.py", """
+            import jax
+            def build(fn):
+                return jax.jit(fn)
+        """)
+        assert run_check(tmp_path, ["R9"]) == []
+
+
+# ---------------------------------------------------------------------------
 # --stale-allows + the fingerprint cache
 # ---------------------------------------------------------------------------
 

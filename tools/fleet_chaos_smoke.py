@@ -33,6 +33,15 @@ process alive at drain time exiting 0, no flight dumps:
    repaired fleet answers the grown-corpus oracle byte-for-byte and
    drains rc 0.
 
+4. **Warm compile-cache relaunch**: a replica is cold-started against
+   an empty persistent compile cache (``--compile-cache``), drained,
+   and relaunched against the now-populated cache. The relaunch must
+   recover strictly faster (``fleet/chaos_warm_cache/
+   cold_start_compile_ms`` is the warm number, gated lower-is-better),
+   with a flat bucket ``compile_count`` and byte-identical golden
+   replies from the warmed executables — the fleet cold-start story
+   measured, not assumed.
+
 Each campaign lands a ``fleet/chaos_*/...`` RunRecord; the file is
 ingested by the perf ledger and the series are perf-gate-covered
 (``FLEET_CHAOS_r15.jsonl`` is the committed round).
@@ -481,6 +490,56 @@ def main(argv=None) -> int:
     say("divergence fleet drain OK: router + both replicas exited 0, "
         "no flight dumps")
 
+    # ---- campaign 4: warm compile-cache relaunch ----------------------------
+    import shutil
+    ccdir = os.path.join(out, "compile_cache")
+    shutil.rmtree(ccdir, ignore_errors=True)   # cold arm = empty cache
+    colds, counts = [], []
+    for gen in ("cold", "warm"):
+        fp = fh.spawn_replica(corpus_path, out, f"replica_cc_{gen}",
+                              warm, batch_cap=BATCH_CAP,
+                              compile_cache=ccdir)
+        try:
+            fh.await_replica(fp)
+            colds.append(fp.ready["cold_start_compile_ms"])
+            counts.append(fp.ready["compile_count"])
+            res5 = sc.replay(fp.ready["port"], HEADER, REQS[:4])
+            if any(not r.get("ok") for r in res5) or \
+                    sc.contract_text([r["checksums"] for r in res5]) \
+                    != sc.contract_text(golden[:4]):
+                fail(f"{gen}-cache replica does not serve golden")
+            cli = sc.ServeClient(fp.ready["port"])
+            cli.drain()
+            cli.close()
+            rc = fp.proc.wait(timeout=120)
+            if rc != 0:
+                fail(f"{gen}-cache replica drain exited {rc}; "
+                     f"see {fp.errlog}")
+        finally:
+            fh.kill_all([fp])
+    if counts[1] != counts[0]:
+        fail(f"warm relaunch changed bucket compile_count: "
+             f"{counts[0]} -> {counts[1]} (the cache must not alter "
+             "which programs are built, only how fast)")
+    if not (colds[1] < colds[0]):
+        fail(f"warm relaunch did not recover faster: cold "
+             f"{colds[0]} ms -> warm {colds[1]} ms (persistent "
+             f"compile cache at {ccdir} had no effect)")
+    say(f"warm-cache relaunch OK: cold start {colds[0]:.0f} ms -> "
+        f"warm {colds[1]:.0f} ms "
+        f"({100.0 * (1 - colds[1] / colds[0]):.0f}% faster recovery, "
+        f"compile_count flat at {counts[0]}, warm replies golden)")
+    RunRecord(
+        kind="fleet", tool="tools.fleet_chaos_smoke",
+        config={"level": "chaos_warm_cache", "replicas": 1,
+                "mode": "persistent_compile_cache_relaunch"},
+        metrics={"cold_start_compile_ms": colds[1],
+                 "cold_start_compile_ms_cold": colds[0],
+                 "warm_recovery_speedup":
+                     round(colds[0] / max(colds[1], 1e-9), 3),
+                 "compile_count": counts[1]},
+        device=device).append_jsonl(record)
+
     # ---- ledger round-trip + gate coverage ----------------------------------
     from dmlp_tpu.obs.ledger import ingest_file
     entry = ingest_file(record)
@@ -489,7 +548,8 @@ def main(argv=None) -> int:
              f"{entry.get('error')}")
     series = {p["series"] for p in entry["points"]}
     for want_s in ("fleet/chaos_kill/p99_ms", "fleet/chaos_split/p99_ms",
-                   "fleet/chaos_divergence/repair_ms"):
+                   "fleet/chaos_divergence/repair_ms",
+                   "fleet/chaos_warm_cache/cold_start_compile_ms"):
         if want_s not in series:
             fail(f"ledger series missing {want_s} "
                  f"(got {sorted(series)[:8]}...)")
