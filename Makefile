@@ -9,7 +9,7 @@ CXXFLAGS ?= -O3 -Wall -shared -fPIC
 	perf-gate check lint chaos-smoke telemetry-smoke serve-smoke \
 	race-smoke prune-smoke precision-smoke fleet-smoke \
 	fleet-chaos-smoke fleet-trace-smoke slo-smoke auto-smoke \
-	serve-bench fleet-bench clean
+	hlo-smoke serve-bench fleet-bench clean
 
 all: native
 
@@ -21,7 +21,7 @@ native/_fastparse.so: native/fastparse.cpp
 test: obs-smoke obs-dist-smoke tune-smoke perf-gate check lint \
 	chaos-smoke telemetry-smoke serve-smoke race-smoke prune-smoke \
 	precision-smoke fleet-smoke fleet-chaos-smoke fleet-trace-smoke \
-	slo-smoke auto-smoke
+	slo-smoke auto-smoke hlo-smoke
 	python -m pytest tests/ -q
 
 # Static analysis + runtime-sanitizer smoke (README "Static analysis &
@@ -357,6 +357,23 @@ auto-smoke:
 	assert any(x.startswith('auto/config1/') for x in s), sorted(s); \
 	sys.path.insert(0, 'tools'); import perf_gate as pg; \
 	assert pg.gated('auto/config1/engine_ms_auto')"
+
+# Compiled-program introspection smoke (README "Compiler
+# introspection"): bench input 1 through the real CLI per engine mode
+# (sharded / ring / auto) with --hlo-report — contract stdout
+# byte-identical to the plain run; the sharded engine's compiled
+# all-gather bytes and the ring engine's compiled collective-permute
+# bytes (while-loop trip counts folded in) reconcile against their own
+# analytic comms models within COMMS_RATIO_BOUNDS; the auto (GSPMD)
+# engine's report names at least one partitioner-chosen collective
+# with nonzero per-mesh-axis bytes and exactly-reconciling gspmd_*
+# records; the memory leg carries hlo_peak_bytes or the explicit
+# hlo_memory_unavailable marker; and each kind="hlo" RunRecord
+# round-trips the ledger as a gated hlo/<mode>/ series
+# (HLO_r20.jsonl is the committed round).
+hlo-smoke:
+	mkdir -p outputs/hlo
+	JAX_PLATFORMS=cpu python tools/hlo_smoke.py --out outputs/hlo
 
 # Fleet SLO bench (not in `make test`; emits the FLEET_rNN ledger
 # rounds): 2 replicas (one mesh-resident) + router, the paced trace

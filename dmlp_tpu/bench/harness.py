@@ -1052,7 +1052,12 @@ def _measure_auto_ab(cfg: BenchConfig, input_path: str,
       no multi-device mesh — on a 1-device CPU container all three
       arms compile 1x1-mesh programs with no cross-shard merge at
       all, so the timings compare jit overheads, not collective
-      schedules (the TPU round owns the qualified claim).
+      schedules (the TPU round owns the qualified claim);
+    - ``auto_hlo_bytes_*`` / ``auto_hlo_collectives_auto``: each arm's
+      compiled-program collective bytes (CLI ``--hlo-report``, obs.hlo)
+      — the A/B compares communication volume, not just wall time, and
+      the auto arm's entry names which collectives GSPMD actually chose
+      (``auto_hlo_unavailable`` marker when introspection failed).
 
     Never raises: failures record ``auto_ab_unavailable``."""
     import re as _re
@@ -1065,6 +1070,9 @@ def _measure_auto_ab(cfg: BenchConfig, input_path: str,
     times: dict = {a: [] for a in arms}
     compile_ms: dict = {a: [] for a in arms}
     outputs: dict = {a: set() for a in arms}
+    hlo_paths = {a: os.path.join(
+        outputs_dir, f"hlo_auto_ab_{a}_config{cfg.config_id}.jsonl")
+        for a in arms}
     try:
         for rep in range(max(pairs, 1)):
             order = arms if rep % 2 == 0 else tuple(reversed(arms))
@@ -1072,7 +1080,8 @@ def _measure_auto_ab(cfg: BenchConfig, input_path: str,
                 out_path, err_path = run_engine(
                     cfg, input_path, outputs_dir, mode=arm, fast=fast,
                     timeout_s=timeout_s, env=env,
-                    obs_flags=["--phase-times"])
+                    obs_flags=["--phase-times",
+                               "--hlo-report", hlo_paths[arm]])
                 with open(out_path) as f:
                     outputs[arm].add(f.read())
                 with open(err_path) as f:
@@ -1112,6 +1121,23 @@ def _measure_auto_ab(cfg: BenchConfig, input_path: str,
         if med[rival] > 0:
             res[f"auto_ab_pct_vs_{rival}"] = round(
                 (med["auto"] - med[rival]) / med[rival] * 100.0, 2)
+    # Communication-volume side of the A/B: each arm's compiled-program
+    # collective bytes, and the auto arm's partitioner-chosen schedule
+    # (introspection runs outside the CLI's timed region, so the
+    # timings above are unaffected).
+    import json as _json
+    for a in arms:
+        try:
+            with open(hlo_paths[a]) as f:
+                hdoc = _json.loads(f.read().splitlines()[-1])
+            res[f"auto_hlo_bytes_{a}"] = \
+                hdoc["metrics"]["collective_bytes_total"]
+            if a == "auto":
+                res["auto_hlo_collectives_auto"] = sorted(
+                    (hdoc["comms"].get("collective_totals") or {}))
+        except Exception as e:
+            res.setdefault("auto_hlo_unavailable", {})[a] = \
+                f"{type(e).__name__}: {e}"
     if not cfg.virtual_devices or cfg.virtual_devices <= 1:
         res["auto_ab_degenerate_mesh"] = True
     else:

@@ -27,9 +27,10 @@ from dmlp_tpu.check.facts import PackageFacts, module_facts
 from dmlp_tpu.check.findings import Finding
 
 ALL_FAMILIES = ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8",
-                "R9")
+                "R9", "R10")
 #: families make check enforces by default; R0 rides in `make lint`
-DEFAULT_FAMILIES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9")
+DEFAULT_FAMILIES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9",
+                    "R10")
 
 
 def package_root() -> str:
@@ -90,6 +91,7 @@ def build_rules(facts: PackageFacts,
     from dmlp_tpu.check.concurrency import ConcurrencyRule
     from dmlp_tpu.check.collectives import CollectiveRule
     from dmlp_tpu.check.dispatchcost import DispatchCostRule
+    from dmlp_tpu.check.hlointro import HloIntroRule
     from dmlp_tpu.check.hostsync import HostSyncRule
     from dmlp_tpu.check.hygiene import HygieneRule
     from dmlp_tpu.check.lowprec import LowPrecRule
@@ -120,6 +122,8 @@ def build_rules(facts: PackageFacts,
         rules.append(LowPrecRule(facts))
     if "R9" in fams:
         rules.append(AutoShardRule(facts))
+    if "R10" in fams:
+        rules.append(HloIntroRule(facts))
     return rules
 
 

@@ -17,6 +17,14 @@ axis discipline to the declarative sharding surface the auto engine
   first dispatch's committed layouts — an unpinned jit there can
   benchmark a different (resharding-on-entry) program than the one the
   A/B record names.
+- **R903**: a ``with_sharding_constraint`` whose sharding arrives
+  through a local variable (``qsh = NamedSharding(mesh, P(...))``)
+  must resolve, through that binding, to declared ``*_AXIS`` axes.
+  R901 checks the ``P(...)`` construction; R903 closes the variable
+  indirection at the constraint site. Names that don't resolve to a
+  single consistent NamedSharding binding are skipped, not guessed at;
+  inline ``P``/``NamedSharding`` args are R901's job (no double
+  report).
 
 Axis expressions resolve exactly like R1 (``check.collectives
 .resolve_axis``): string literals, ``*_AXIS`` constants (local or
@@ -28,7 +36,7 @@ axes.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Set
+from typing import Dict, Optional, Set
 
 from dmlp_tpu.check.collectives import resolve_axis
 from dmlp_tpu.check.common import ModuleInfo, call_name
@@ -59,6 +67,23 @@ def _is_jit_call(call: ast.Call) -> bool:
     return name in ("jax.jit", "jit")
 
 
+def _is_named_sharding_call(call: ast.Call, mod: ModuleInfo) -> bool:
+    name = call_name(call)
+    if name is None:
+        return False
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf == "NamedSharding":
+        return True
+    src = mod.imports.get(leaf, "")
+    return src.rsplit(".", 1)[-1] == "NamedSharding"
+
+
+def _is_wsc_call(call: ast.Call) -> bool:
+    name = call_name(call)
+    return name is not None \
+        and name.rsplit(".", 1)[-1] == "with_sharding_constraint"
+
+
 class AutoShardRule:
     """One instance runs over the whole package; declared axes come
     from the merged PackageFacts (same source R1 reads)."""
@@ -68,6 +93,7 @@ class AutoShardRule:
         self.declared: Set[str] = facts.declared
 
     def run(self, mod: ModuleInfo, add) -> None:
+        sharding_vars = None    # built lazily: most files have no wsc
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -76,6 +102,10 @@ class AutoShardRule:
             elif _is_jit_call(node) \
                     and mod.relpath.replace("\\", "/") == AUTO_ENGINE_PATH:
                 self._check_jit_pinning(mod, node, add)
+            if _is_wsc_call(node):
+                if sharding_vars is None:
+                    sharding_vars = self._sharding_vars(mod)
+                self._check_constraint(mod, node, sharding_vars, add)
 
     def _check_spec_axes(self, mod: ModuleInfo, node: ast.Call,
                          add) -> None:
@@ -115,3 +145,82 @@ class AutoShardRule:
             f"out_shardings (missing {missing}) or carry "
             f"`# check: allow-auto-shard` — an unpinned jit lets "
             f"the partitioner infer placements from the first dispatch"))
+
+    # -- R903: variable-held shardings at constraint sites ------------------
+    def _sharding_vars(self, mod: ModuleInfo):
+        """name -> set of axes from every ``name = NamedSharding(...,
+        P(...))`` binding in the module, or None for names also bound
+        to something else (opaque: the reaching binding is unknown).
+        Same-name bindings in different functions merge — each binding's
+        axes must be declared anyway, so the union checks them all."""
+        out: Dict[str, Optional[Set[str]]] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign) \
+                    or len(node.targets) != 1 \
+                    or not isinstance(node.targets[0], ast.Name):
+                continue
+            name = node.targets[0].id
+            v = node.value
+            if not (isinstance(v, ast.Call)
+                    and _is_named_sharding_call(v, mod)):
+                out[name] = None     # not (only) a sharding binding
+                continue
+            axes = self._spec_call_axes(mod, v)
+            if axes is None:
+                out[name] = None         # spec unresolvable: opaque
+            elif out.get(name, set()) is not None:
+                out[name] = out.get(name) or set()
+                out[name].update(axes)
+        return out
+
+    def _spec_call_axes(self, mod: ModuleInfo,
+                        ns_call: ast.Call) -> Optional[Set[str]]:
+        """Resolved axis names of the PartitionSpec inside one
+        NamedSharding construction; None when any entry is opaque."""
+        spec = None
+        for arg in list(ns_call.args) + [kw.value for kw in
+                                         ns_call.keywords
+                                         if kw.arg == "spec"]:
+            if isinstance(arg, ast.Call) and _is_pspec_call(arg, mod):
+                spec = arg
+        if spec is None:
+            return None
+        axes: Set[str] = set()
+        for arg in spec.args:
+            if isinstance(arg, ast.Constant) and arg.value is None:
+                continue    # replication entry, not an axis
+            resolved = resolve_axis(arg, mod, self.axis_consts)
+            if resolved is None or (isinstance(resolved, tuple)
+                                    and resolved[0] == "param"):
+                return None
+            axes.update(resolved if isinstance(resolved, list)
+                        else [resolved])
+        return axes
+
+    def _check_constraint(self, mod: ModuleInfo, node: ast.Call,
+                          sharding_vars, add) -> None:
+        spec_arg = node.args[1] if len(node.args) >= 2 else None
+        if spec_arg is None:
+            for kw in node.keywords:
+                if kw.arg == "shardings":
+                    spec_arg = kw.value
+        # Only variable indirection: inline P(...)/NamedSharding(...)
+        # constructions are R901's finding site already.
+        if not isinstance(spec_arg, ast.Name):
+            return
+        axes = sharding_vars.get(spec_arg.id)
+        if axes is None:
+            return              # unknown or opaque binding: not guessed at
+        for ax in sorted(axes):
+            if ax in self.declared:
+                continue
+            if mod.allowed_value(node, ALLOW, "R903"):
+                continue
+            add(Finding(
+                "R903", mod.relpath, node.lineno, node.col_offset,
+                mod.scope_of(node), f"wsc:{ax}",
+                f"with_sharding_constraint spec ({spec_arg.id}) "
+                f"resolves to mesh axis {ax!r}, which no *_AXIS "
+                f"constant declares (declared: "
+                f"{sorted(self.declared)}) — the constraint silently "
+                f"replicates"))

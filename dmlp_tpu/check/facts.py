@@ -44,8 +44,30 @@ def module_facts(mod: ModuleInfo) -> Dict[str, Any]:
         "axis_helpers": axis_helpers,
         "metric_sites": registration_facts(mod),
         "modeled_kernels": _modeled_from_tree(mod.tree),
+        "hlo_model_keys": _hlo_table_keys(mod.tree),
         "concurrency": module_conc_facts(mod),
     }
+
+
+def _hlo_table_keys(tree: ast.AST) -> List[str]:
+    """String keys of a module-level ``MODEL_COLLECTIVE_KINDS`` dict
+    literal — only meaningful for obs/hlo.py (the R10 reconcile table),
+    but harmless elsewhere. Covers plain and annotated assignment."""
+    for stmt in getattr(tree, "body", []):
+        target = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            target = stmt.targets[0].id
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name):
+            target = stmt.target.id
+        if target != "MODEL_COLLECTIVE_KINDS" \
+                or not isinstance(stmt.value, ast.Dict):
+            continue
+        return sorted(k.value for k in stmt.value.keys
+                      if isinstance(k, ast.Constant)
+                      and isinstance(k.value, str))
+    return []
 
 
 def _modeled_from_tree(tree: ast.AST) -> List[str]:
@@ -78,6 +100,8 @@ class PackageFacts:
         saw_kernel_cost = False
         eps_fns: Set[str] = set()
         saw_finalize = False
+        hlo_keys: Set[str] = set()
+        saw_hlo = False
         metric_sites: List[Tuple[str, int, str, str]] = []
         conc_pairs: List[Tuple[str, Dict[str, Any]]] = []
         for rel, facts in self.pairs:
@@ -96,6 +120,9 @@ class PackageFacts:
                 saw_finalize = True
                 eps_fns.update(n for n in facts.get("defs", [])
                                if "eps" in n)
+            if rel_n.endswith("obs/hlo.py"):
+                saw_hlo = True
+                hlo_keys.update(facts.get("hlo_model_keys", []))
             for seq, (name, kind) in enumerate(
                     facts.get("metric_sites", [])):
                 metric_sites.append((rel, seq, name, kind))
@@ -131,6 +158,15 @@ class PackageFacts:
         else:
             self.eps_models = _installed_eps_models()
             self._fallback_eps = sorted(self.eps_models or [])
+        #: the obs/hlo.py MODEL_COLLECTIVE_KINDS keys — the R1001
+        #: validation table; None = unknown (the rule stays silent).
+        #: Same installed-package fallback + digest obligation as above.
+        self._fallback_hlo: Optional[List[str]] = None
+        if saw_hlo:
+            self.hlo_models: Optional[Set[str]] = hlo_keys or None
+        else:
+            self.hlo_models = _installed_hlo_models()
+            self._fallback_hlo = sorted(self.hlo_models or [])
         self.concurrency = ConcurrencyGraph(conc_pairs)
 
     def digest(self) -> str:
@@ -139,7 +175,7 @@ class PackageFacts:
         invalidates every file's findings; a facts-neutral edit only
         invalidates the edited file)."""
         blob = json.dumps([self.pairs, self._fallback_models,
-                           self._fallback_eps],
+                           self._fallback_eps, self._fallback_hlo],
                           sort_keys=True,
                           separators=(",", ":")).encode()
         return hashlib.sha256(blob).hexdigest()
@@ -155,6 +191,19 @@ def _installed_modeled_kernels() -> Optional[Set[str]]:
     except (OSError, SyntaxError):
         return None
     names = set(_modeled_from_tree(tree))
+    return names or None
+
+
+def _installed_hlo_models() -> Optional[Set[str]]:
+    import os
+    try:
+        from dmlp_tpu.check.analyzer import package_root
+        path = os.path.join(package_root(), "obs", "hlo.py")
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    names = set(_hlo_table_keys(tree))
     return names or None
 
 

@@ -83,6 +83,15 @@ RULES = {
             "out_shardings (the partitioner must see the full"
             " placement contract, not infer it from the first"
             " dispatch)",
+    "R903": "with_sharding_constraint spec resolves to a mesh axis no"
+            " *_AXIS constant declares (the constraint silently"
+            " replicates — same failure mode as R901, caught at the"
+            " constraint site through variable-held shardings)",
+    # R10 — compiled-program introspection contract (obs/hlo.py)
+    "R1001": "comms-model annotation names a model the obs/hlo.py"
+             " reconcile table (MODEL_COLLECTIVE_KINDS) does not map"
+             " to an HLO collective kind — the HLO-vs-model reconcile"
+             " silently skips the site",
 }
 
 #: rule id -> allowlist directive that silences it at a call site.
@@ -97,6 +106,7 @@ ALLOW_DIRECTIVES = {
     "R7": "allow-concurrency",
     "R8": "allow-lowprec",
     "R9": "allow-auto-shard",
+    "R10": "allow-hlo-model",
 }
 
 #: every directive that SUPPRESSES a finding (for ``--stale-allows``):
@@ -115,8 +125,10 @@ def is_suppression_directive(directive: str) -> bool:
 
 
 def family(rule: str) -> str:
-    """"R103" -> "R1"."""
-    return rule[:2]
+    """"R103" -> "R1"; "R1001" -> "R10". Every rule id is its family
+    plus a 2-digit index, so the family is the id minus the last two
+    digits (``[:2]`` would misfile R10xx under R1)."""
+    return rule[:-2]
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,97 @@
+"""R10 — the compiled-program introspection contract.
+
+R1's traffic rules (R103/R104) make every byte-moving collective in
+engine/, parallel/, train/ carry a ``# check: comms-model=<fn>``
+annotation naming its analytic model in obs/comms.py. PR 20's
+HLO-derived ledger (obs/hlo.py) reconciles those models against the
+bytes the compiled program actually schedules — but only for models its
+``MODEL_COLLECTIVE_KINDS`` table maps to an HLO collective kind. An
+annotation naming a model the table lacks passes R104 (the function
+exists) yet reconciles NOTHING: the HLO-vs-model comparison silently
+skips the site, which is exactly the silent-gap failure mode the
+introspection exists to close.
+
+- **R1001**: every ``comms-model=`` annotation in the traffic scope
+  must name a key of ``obs/hlo.py``'s ``MODEL_COLLECTIVE_KINDS``. When
+  a model is genuinely un-reconcilable (no HLO twin), map it in the
+  table or waive the site with ``# check: allow-hlo-model``.
+
+The table keys ride the package facts like the R104 comms-model set
+(installed-package fallback for single-file fixture runs, folded into
+the merged digest); when the table is unknown the rule stays silent
+rather than flagging everything.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from dmlp_tpu.check.common import ModuleInfo
+from dmlp_tpu.check.findings import Finding
+
+ALLOW = "allow-hlo-model"
+
+#: directories whose comms-model annotations must reconcile — the same
+#: scope whose collectives R103 forces to carry them
+HLO_SCOPE = ("dmlp_tpu/engine/", "dmlp_tpu/parallel/", "dmlp_tpu/train/")
+
+_PREFIX = "comms-model="
+
+
+def _stmt_at(mod: ModuleInfo, line: int) -> Optional[ast.stmt]:
+    """The innermost statement whose span covers ``line`` (directives
+    land on code lines, so one normally exists; None for e.g. an
+    annotation inside a docstring)."""
+    best: Optional[ast.stmt] = None
+    best_span = None
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        lo = getattr(node, "lineno", 0)
+        hi = getattr(node, "end_lineno", 0) or lo
+        if not (lo <= line <= hi):
+            continue
+        span = hi - lo
+        if best_span is None or span <= best_span:
+            best, best_span = node, span
+    return best
+
+
+class HloIntroRule:
+    """One instance runs over the whole package; the reconcile table
+    comes from the merged PackageFacts (same plumbing R104 uses for
+    the obs/comms.py def set)."""
+
+    def __init__(self, facts):
+        self.hlo_models = facts.hlo_models   # None = unknown: silent
+
+    def run(self, mod: ModuleInfo, add) -> None:
+        if self.hlo_models is None:
+            return
+        rel = mod.relpath.replace("\\", "/")
+        if not any(rel.startswith(p) or f"/{p}" in rel
+                   for p in HLO_SCOPE):
+            return
+        for line in sorted(mod.directives):
+            models: List[str] = []
+            for d in sorted(mod.directives[line]):
+                if d.startswith(_PREFIX):
+                    models.extend(x for x in d[len(_PREFIX):].split(",")
+                                  if x)
+            for m in models:
+                if m in self.hlo_models:
+                    continue
+                stmt = _stmt_at(mod, line)
+                if stmt is not None \
+                        and mod.allowed_value(stmt, ALLOW, "R1001"):
+                    continue
+                add(Finding(
+                    "R1001", mod.relpath, line, 0,
+                    mod.scope_of(stmt) if stmt is not None else "",
+                    f"comms-model:{m}",
+                    f"comms-model annotation names {m!r}, which "
+                    f"obs/hlo.py's MODEL_COLLECTIVE_KINDS does not map "
+                    f"to an HLO collective kind — the HLO-vs-model "
+                    f"reconcile silently skips this site (map it or "
+                    f"annotate `# check: allow-hlo-model`)"))

@@ -17,7 +17,7 @@ Usage::
 
     python -m dmlp_tpu [--mode single|sharded|ring|auto] [--debug] [--fast]
                        [--engine jax|golden|auto] [--phase-times]
-                       [--compile-cache DIR]
+                       [--compile-cache DIR] [--hlo-report FILE]
                        [--trace FILE] [--metrics FILE] [--counters] < input.in
 """
 
@@ -222,6 +222,15 @@ def main(argv: Optional[Sequence[str]] = None,
                         help="append JSONL metrics to FILE; the final "
                              "summary record carries cost-analysis "
                              "counters and collective-traffic accounting")
+    parser.add_argument("--hlo-report", metavar="FILE", default=None,
+                        help="append one kind='hlo' RunRecord to FILE: "
+                             "the compiled program's collective "
+                             "schedule, memory_analysis and cost "
+                             "(obs.hlo HloReport per executable) plus "
+                             "the three-way reconcile vs the analytic "
+                             "comms/memwatch models and any trace; "
+                             "implies dispatch recording. Contract "
+                             "channels stay byte-identical")
     parser.add_argument("--counters", action="store_true",
                         help="print an XLA cost-analysis + roofline "
                              "summary to stderr (extension; implies "
@@ -283,7 +292,7 @@ def main(argv: Optional[Sequence[str]] = None,
         from dmlp_tpu.obs import trace as obs_trace
         tracer = obs_trace.install(
             obs_trace.Tracer(annotate=bool(args.profile)))
-    if args.metrics or args.counters:
+    if args.metrics or args.counters or args.hlo_report:
         from dmlp_tpu.obs import counters as obs_counters
         probe = obs_counters.install()
     schedule = rs_inject.install_from_env(args.faults)
@@ -385,12 +394,20 @@ def _run_cli(parser, args, stdin, stdout, stderr, tracer, probe) -> int:
         if probe is not None:
             with obs_span("cli.collect_counters"):
                 counters = probe.collect()
+        hlo_rep_auto = None
+        if args.hlo_report and engine is not None \
+                and hasattr(engine, "comms_from_hlo"):
+            # Derive the GSPMD engine's real comms record from the
+            # compiled program BEFORE summarizing, so the metrics
+            # comms block and the hlo reconcile see the same traffic.
+            with obs_span("cli.hlo_derive_comms"):
+                hlo_rep_auto = engine.comms_from_hlo()
         comms = None
         if engine is not None and getattr(engine, "last_comms", None):
             from dmlp_tpu.obs.comms import summarize
             comms = summarize(engine.last_comms)
         mem_model = None
-        if args.metrics and engine is not None:
+        if (args.metrics or args.hlo_report) and engine is not None:
             # Only _emit_metrics consumes the reconcile; a
             # --counters/--trace-only run must not pay the
             # live-array enumeration for a discarded result.
@@ -421,9 +438,56 @@ def _run_cli(parser, args, stdin, stdout, stderr, tracer, probe) -> int:
                           if engine is not None else None)
         if args.counters:
             _emit_counters_stderr(counters, timer.elapsed_ms, stderr)
+        if args.hlo_report and probe is not None:
+            with obs_span("cli.hlo_report"):
+                _emit_hlo_report(args, engine, probe, tracer, mem_model,
+                                 hlo_rep_auto)
         if tracer is not None:
             tracer.write(args.trace)
     return 0
+
+
+def _emit_hlo_report(args, engine, probe, tracer, mem_model,
+                     hlo_rep_auto) -> None:
+    """Append the kind='hlo' RunRecord: per-executable HloReports for
+    every recorded dispatch signature + the three-way reconcile (HLO vs
+    analytic comms models vs traced spans vs the memwatch mem block).
+    Entirely outside the timed region; never raises into the run."""
+    try:
+        from dmlp_tpu.obs import hlo as obs_hlo
+        from dmlp_tpu.obs.run import (RunRecord, current_device,
+                                      round_from_name)
+        reports, skipped = obs_hlo.probe_reports(probe)
+        if hlo_rep_auto is not None and not any(
+                rep.fingerprint == hlo_rep_auto.fingerprint
+                for rep, _c, _s in reports):
+            reports.append((hlo_rep_auto, 1, "auto.solve"))
+        mesh_axes = None
+        if engine is not None and getattr(engine, "mesh", None) \
+                is not None:
+            mesh_axes = dict(zip(engine.mesh.axis_names,
+                                 engine.mesh.devices.shape))
+        doc = obs_hlo.build_report_doc(
+            reports, skipped=skipped,
+            traffics=getattr(engine, "last_comms", None)
+            if engine is not None else None,
+            events=tracer.events() if tracer is not None else None,
+            mem_block=mem_model, mesh_axes=mesh_axes)
+        rec = RunRecord(
+            kind="hlo", tool="dmlp_tpu.cli",
+            config={"mode": args.mode, "engine": args.engine,
+                    "exact": not args.fast,
+                    **({"mesh": list(mesh_axes.values())}
+                       if mesh_axes else {})},
+            metrics=obs_hlo.flat_metrics(doc),
+            comms=doc,
+            device=current_device(),
+            round=round_from_name(args.hlo_report))
+        rec.append_jsonl(args.hlo_report)
+    except Exception as e:  # check: no-retry — obs never fails a run
+        import sys as _sys
+        _sys.stderr.write(f"warning: --hlo-report failed: "
+                          f"{type(e).__name__}: {e}\n")
 
 
 if __name__ == "__main__":

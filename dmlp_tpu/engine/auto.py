@@ -44,10 +44,17 @@ the jit (R2 discipline):
   the hand-rolling this engine exists to avoid), so the toggle cannot
   change its results.
 
-No analytic comms model: the schedule is the compiler's, so
-``last_comms`` stays empty rather than asserting traffic this module
-never dispatched (obs.comms.engine_comms returns the same honest empty
-for the "gspmd" merge strategy).
+No ANALYTIC comms model: the schedule is the compiler's, so
+obs.comms.engine_comms returns the honest empty for the "gspmd" merge
+strategy rather than asserting traffic this module never dispatched.
+Since PR 20 the record is no longer empty, though — it is *derived*:
+:meth:`AutoShardedEngine.comms_from_hlo` reads the compiled program's
+collective schedule (obs.hlo) and populates ``last_comms`` with
+``gspmd_*`` traffic records naming which collectives the partitioner
+actually chose, on which mesh axis, and how many bytes they move. The
+derivation lowers outside the timed region and only when introspection
+is requested (CLI ``--hlo-report``, bench ``--auto-ab``), so the solve
+path itself stays claim-free.
 """
 
 from __future__ import annotations
@@ -87,7 +94,8 @@ class AutoShardedEngine(ShardedEngine):
     """
 
     # Not a hand-rolled merge: obs.comms has no analytic model for a
-    # compiler-chosen schedule and deliberately reports no traffic.
+    # compiler-chosen schedule; comms_from_hlo() derives the real one
+    # from the compiled program on request.
     _merge_strategy = "gspmd"
 
     def __init__(self, config: EngineConfig = EngineConfig(mode="auto"),
@@ -259,6 +267,10 @@ class AutoShardedEngine(ShardedEngine):
 
         fn = self._fn_auto(k, data_block, select)
         obs_counters.record_dispatch(fn, args, site="auto.solve")
+        # Shape specs only (no buffers kept alive): comms_from_hlo()
+        # re-lowers this signature post-solve to read the schedule.
+        self._last_dispatch = (fn, jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args))
 
         def _op():
             rs_inject.fire("auto.solve", which="gspmd")
@@ -279,10 +291,38 @@ class AutoShardedEngine(ShardedEngine):
         # streaming selects take any k natively, so nothing routes
         self.last_phase_ms = {}
         self.last_comms = []         # compiler-chosen schedule: no
-        # analytic traffic claim (module docstring)
+        # analytic traffic claim until comms_from_hlo() derives the
+        # real one from the compiled program (module docstring)
+        self._last_dispatch = None
         self._pending_iters = []
         self.last_extract_impl = None
         self.last_prune = None
+
+    def comms_from_hlo(self):
+        """Derive the REAL comms record from the compiled program.
+
+        Lowers the last solve's dispatch signature (shape specs stored
+        by ``_solve_auto``), reads its collective schedule via obs.hlo,
+        and populates ``last_comms`` with ``gspmd_*`` CollectiveTraffic
+        records (which collectives GSPMD chose, on which mesh axis, how
+        many bytes). Returns the :class:`~dmlp_tpu.obs.hlo.HloReport`,
+        or None when no solve ran or the signature cannot lower —
+        introspection never raises into the solve path. Call it OUTSIDE
+        the timed region: the AOT lower+compile is not free (the
+        fingerprint cache dedupes repeat calls)."""
+        from dmlp_tpu.obs import hlo as obs_hlo
+        disp = getattr(self, "_last_dispatch", None)
+        if disp is None:
+            return None
+        fn, specs = disp
+        rep = obs_hlo.report_for_fn(fn, specs, label="auto.solve")
+        if rep is None:
+            return None
+        mesh_axes = dict(zip(self.mesh.axis_names,
+                             self.mesh.devices.shape))
+        self.last_comms = obs_hlo.traffic_from_report(
+            rep, mesh_axes=mesh_axes)
+        return rep
 
     def _solve_segments(self, inp: KNNInput):
         self._reset_solve_state()

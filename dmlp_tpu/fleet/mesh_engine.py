@@ -750,6 +750,14 @@ class MeshResidentEngine(ResidentServingCore, ShardedEngine):
         return {
             "buckets": sorted(e.key for e in entries),
             "paths": {e.key: e.path for e in entries},
+            # Mesh buckets dispatch shard_map jits through the jit
+            # cache — there is no per-bucket jax.stages.Compiled handle
+            # to fingerprint (the single-chip ResidentEngine's
+            # stream-path map is the populated case); explicit marker,
+            # not silence.
+            "hlo_schedule": {},
+            "hlo_unavailable": "mesh buckets have no AOT-compiled "
+                               "stream handle",
             "compile_count": self.compile_count,
             "bucket_compile_ms": dict(self.bucket_compile_ms),
             "cold_start_compile_ms": self.cold_start_compile_ms,

@@ -37,21 +37,45 @@ __all__ = ["CostProbe", "normalize_cost", "lowered_cost", "roofline",
            "record_measured_iters"]
 
 
+# cost_analysis() shapes normalize_cost could not use, deduplicated and
+# bounded — attached to the counters_unavailable marker so the next JAX
+# API drift (a renamed key, a new container type) is diagnosable from a
+# ledger entry instead of a repro session.
+_UNRECOGNIZED_MAX = 4
+_unrecognized_shapes: list = []
+
+
+def _note_unrecognized(raw) -> None:
+    desc: Dict[str, Any] = {"type": type(raw).__name__}
+    if isinstance(raw, dict):
+        desc["keys"] = sorted(str(k) for k in raw)[:16]
+    if desc not in _unrecognized_shapes and \
+            len(_unrecognized_shapes) < _UNRECOGNIZED_MAX:
+        _unrecognized_shapes.append(desc)
+
+
 def normalize_cost(raw) -> Optional[Dict[str, float]]:
     """Normalize ``cost_analysis()`` output across JAX versions: a dict,
     a one-element list of dicts, or None. Returns {flops, bytes_accessed}
-    (floats; absent keys -> 0.0), or None when there is nothing usable."""
+    (floats; absent keys -> 0.0), or None when there is nothing usable —
+    noting the raw shape it could not use (see ``_note_unrecognized``)."""
     if raw is None:
         return None
     if isinstance(raw, (list, tuple)):
         if not raw:
+            _note_unrecognized(raw)
             return None
         raw = raw[0]
     if not isinstance(raw, dict):
+        _note_unrecognized(raw)
         return None
     flops = float(raw.get("flops", 0.0) or 0.0)
     byts = float(raw.get("bytes accessed", 0.0) or 0.0)
     if flops == 0.0 and byts == 0.0:
+        if "flops" not in raw and "bytes accessed" not in raw:
+            # a dict that carries NEITHER expected key is shape drift,
+            # not a genuinely zero-cost program — record its keys
+            _note_unrecognized(raw)
         return None
     return {"flops": flops, "bytes_accessed": byts}
 
@@ -107,6 +131,13 @@ class CostProbe:
         else:
             self._entries[key] = [fn, specs, statics, count, site]
 
+    def dispatches(self) -> list:
+        """Read-only view of the recorded signatures —
+        ``[(fn, spec_args, static_kwargs, count, site), ...]`` — for
+        downstream introspection (obs.hlo lowers each unique signature
+        once to read its compiled collective schedule and memory)."""
+        return [tuple(e) for e in self._entries.values()]
+
     def record_measured_iters(self, site: str, iters_total: int,
                               shape: Tuple[int, int, int, int],
                               kernel: str = "extract") -> None:
@@ -156,8 +187,17 @@ class CostProbe:
                 agg["bytes_accessed"] += cost["bytes_accessed"] * count
                 agg["dispatches"] += count
         if analyzed == 0:
-            return {"counters_unavailable": True,
-                    "dispatches_recorded": dispatches}
+            out = {"counters_unavailable": True,
+                   "dispatches_recorded": dispatches}
+            if _unrecognized_shapes:
+                out["unrecognized_cost_shapes"] = \
+                    [dict(d) for d in _unrecognized_shapes]
+                try:
+                    import jax
+                    out["jax_version"] = jax.__version__
+                except Exception:
+                    pass
+            return out
         # Measured extraction terms: fold each (site, shape, kernel)'s
         # read-back iters count into the totals (count-independent — the
         # engines already summed across that site's dispatches at the

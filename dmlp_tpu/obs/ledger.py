@@ -298,6 +298,18 @@ def _runrecord_series_name(rec: RunRecord, key: str) -> str:
         # round-comparable (gated by tools/perf_gate.py).
         cfg_tag = f"/config{cid}" if cid is not None else ""
         return f"auto{cfg_tag}/{key}"
+    if rec.kind == "hlo":
+        # Compiled-program introspection records (CLI --hlo-report,
+        # obs.hlo): one ``hlo/<mode>/<metric>`` series per engine mode
+        # so the partitioner-chosen collective-bytes trajectory gates
+        # per engine in tools/perf_gate.py — a GSPMD upgrade that
+        # silently doubles all-gather traffic on the auto engine can't
+        # hide behind the hand-rolled engines' unchanged schedules.
+        mode = rec.config.get("mode") if isinstance(rec.config, dict) \
+            else None
+        tag = (f"/{mode}" if mode
+               else (f"/config{cid}" if cid is not None else ""))
+        return f"hlo{tag}/{key}"
     if rec.tool == "dmlp_tpu.bench" and cid is not None:
         return f"harness/config{cid}/{key}"
     if rec.kind == "telemetry":

@@ -257,6 +257,12 @@ class Tracer:
         with self._lock:
             self._events.append(ev)
 
+    def events(self) -> List[dict]:
+        """Thread-safe snapshot of the recorded events — the obs.hlo
+        trace-reconcile leg reads collective span byte args from it."""
+        with self._lock:
+            return list(self._events)
+
     # -- export --------------------------------------------------------------
     def to_dict(self, process_name: str = "dmlp_tpu") -> dict:
         meta = [{"name": "process_name", "ph": "M", "pid": self._pid,

@@ -243,11 +243,17 @@ class ServeDaemon:
         telemetry.registry().gauge("serve.ready").set(1)
 
     def write_ready_file(self, path: str) -> None:
+        stats = self.engine.bucket_stats()
         doc = {
             "port": self.port, "pid": os.getpid(),
             "cold_start_compile_ms": self.engine.cold_start_compile_ms,
             "compile_count": self.engine.compile_count,
-            "buckets": self.engine.bucket_stats()["buckets"],
+            "buckets": stats["buckets"],
+            # per-bucket compiled-stream fingerprints at readiness: the
+            # smoke re-reads this map at drain — a flat compile_count
+            # with a CHANGED fingerprint would mean a recompile landed
+            # on a different program (obs.hlo schedule identity).
+            "hlo_schedule": stats.get("hlo_schedule", {}),
             "warmup_ms": self.warmup_ms,
         }
         tmp = path + ".tmp"
@@ -359,6 +365,7 @@ class ServeDaemon:
         if eng.last_gated_fraction is not None:
             metrics["gate_gated_fraction"] = round(
                 eng.last_gated_fraction, 6)
+        stats = eng.bucket_stats()
         return RunRecord(
             kind="serve", tool="dmlp_tpu.serve",
             config={"corpus_rows": eng.n_real,
@@ -367,7 +374,12 @@ class ServeDaemon:
                     "gate_carry": eng.gate_carry,
                     "mode": ("mesh_resident" if hasattr(eng, "mesh")
                              else "resident"),
-                    "buckets": eng.bucket_stats()["buckets"]},
+                    "buckets": stats["buckets"],
+                    # per-bucket compiled-stream HLO fingerprints
+                    # (obs.hlo; the schedule-identity side of the
+                    # compile-once contract) — {} where the engine has
+                    # no AOT stream handle to introspect
+                    "hlo_schedule": stats.get("hlo_schedule", {})},
             metrics=metrics, device=current_device())
 
     def _append_record(self) -> None:

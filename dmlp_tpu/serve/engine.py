@@ -108,7 +108,8 @@ class _Bucket:
     """One (qpad, k-bucket) shape bucket: the resolved candidate width,
     the chosen path, and the AOT-compiled streaming program."""
 
-    __slots__ = ("qpad", "kb", "kcap", "path", "qb", "nqb", "stream")
+    __slots__ = ("qpad", "kb", "kcap", "path", "qb", "nqb", "stream",
+                 "hlo")
 
     def __init__(self, qpad: int, kb: int, kcap: int, path: str,
                  qb: int, nqb: int):
@@ -116,6 +117,9 @@ class _Bucket:
         self.path = path          # "extract" | "multipass" | "stream"
         self.qb, self.nqb = qb, nqb
         self.stream = None        # AOT-compiled _topk_blocks, when built
+        self.hlo = None           # {"fingerprint", "collective_bytes"}
+        # of the compiled stream (obs.hlo), stamped at compile time in
+        # the batcher thread — stats handlers read it lock-free
 
     @property
     def key(self) -> str:
@@ -482,6 +486,21 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
             k=entry.kcap, data_block=self._data_block,
             select=self._stream_select,
             use_pallas=cfg.use_pallas).compile()
+        try:
+            # Schedule identity for the compile-once contract: the
+            # smoke asserts the per-bucket fingerprint (not just
+            # compile_count) is unchanged between ready and drain — a
+            # recompile that lands on a DIFFERENT program can't hide
+            # behind a coincidentally flat counter.
+            from dmlp_tpu.obs import hlo as obs_hlo
+            rep = obs_hlo.report_for(entry.stream,
+                                     label=f"serve.{entry.key}")
+            entry.hlo = {"fingerprint": rep.fingerprint,
+                         "collective_bytes": sum(
+                             t["bytes_moved"]
+                             for t in rep.totals.values())}
+        except Exception:  # check: no-retry — obs never fails serving
+            entry.hlo = None
 
     # -- resident chunk staging (extract path) --------------------------------
 
@@ -1070,6 +1089,11 @@ class ResidentEngine(ResidentServingCore, SingleChipEngine):
         return {
             "buckets": sorted(e.key for e in entries),
             "paths": {e.key: e.path for e in entries},
+            # bucket key -> compiled-stream HLO fingerprint (obs.hlo;
+            # only stream-path buckets have one): the schedule-identity
+            # map the compile-once assertions compare.
+            "hlo_schedule": {e.key: e.hlo["fingerprint"]
+                             for e in entries if e.hlo},
             "compile_count": self.compile_count,
             "bucket_compile_ms": dict(self.bucket_compile_ms),
             "cold_start_compile_ms": self.cold_start_compile_ms,

@@ -307,6 +307,8 @@ def train(steps: int = 100, batch: int = 1024,
         ckpt_lib.save_checkpoint(checkpoint_dir, state, step=start_step)
 
     last = {}
+    hlo_sig = None   # (step_fn, abstract arg specs) for the one-shot
+    # compiled-program record logged after the loop (obs.hlo)
     t_window = time.perf_counter()
     window_steps = 0
     cur_lr = lr
@@ -316,6 +318,16 @@ def train(steps: int = 100, batch: int = 1024,
     i = start_step
     while i < end:
         xd, yd = next(data)
+        if hlo_sig is None and metrics is not None:
+            # Shape specs only — no buffers kept alive across the loop.
+            try:
+                hlo_sig = (step_fn, jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    (state, xd, yd)))
+            except Exception:  # check: no-retry
+                # Introspection is best-effort: a spec-capture failure
+                # must never take down a training step.
+                hlo_sig = None
 
         def _step_op():
             # The injection fire rides INSIDE the retried op: a
@@ -403,6 +415,30 @@ def train(steps: int = 100, batch: int = 1024,
         i += 1
     if checkpoint_dir:
         ckpt_lib.save_checkpoint(checkpoint_dir, state, step=end)
+    if metrics is not None and hlo_sig is not None:
+        # One-shot compiled-program record (obs.hlo): which collectives
+        # the compiled step ACTUALLY dispatches, alongside the analytic
+        # event="comms" summary logged before the loop. AOT lower runs
+        # after the step loop (untimed) and never raises into training.
+        try:
+            from dmlp_tpu.obs import hlo as obs_hlo
+            fn, specs = hlo_sig
+            rep = obs_hlo.report_for_fn(fn, specs, label="train.step")
+            if rep is None:
+                metrics.log(event="hlo", hlo_unavailable=
+                            "step program could not be lowered for "
+                            "introspection")
+            else:
+                ev = {"event": "hlo", "fingerprint": rep.fingerprint}
+                for kind, agg in sorted(rep.totals.items()):
+                    key = kind.replace("-", "_")
+                    ev[f"{key}_bytes"] = agg["bytes_moved"]
+                    ev[f"{key}_count"] = agg["count"]
+                if "hlo_memory_unavailable" not in rep.memory:
+                    ev["hlo_temp_bytes"] = rep.memory.get("temp_bytes", 0)
+                metrics.log(**ev)
+        except Exception:
+            pass  # check: no-retry — observability must not fail a run
     return state, last
 
 

@@ -63,7 +63,9 @@ request-scoped causal contract:
   out-of-order stream (a firing with no pending before it, a
   firing→pending shortcut, a mismatched prev) fails the check;
 - when the merge embedded client-side reconcile verdicts: the
-  reconciled fraction must reach ``--min-reconciled`` (default 0.9).
+  reconciled fraction must reach ``--min-reconciled`` (default 0.9),
+  and the merge's aggregate ``reconcile_residual_ms`` is printed with
+  its budget verdict (informational — the budget marker never gates).
 
 Exit 0 on success, 1 with a message naming the first violated invariant.
 
@@ -454,6 +456,16 @@ def check_fleet_trace(path: str, emit_json: bool = False,
              f"{reconcile.get('n_requests')} requests within "
              f"tolerance; tol_abs={reconcile.get('tol_abs_ms')}ms "
              f"tol_rel={reconcile.get('tol_rel')})")
+    # The aggregate residual is informational only — the budget marker
+    # is NON-GATING by design (merge_traces.RESIDUAL_BUDGET_MS), so
+    # print, never fail.
+    if "reconcile_residual_ms" in reconcile:
+        note = (f"check_trace: phase-sum residual median "
+                f"{reconcile['reconcile_residual_ms']} ms "
+                f"(budget {reconcile.get('residual_budget_ms')} ms)")
+        if reconcile.get("residual_budget_exceeded"):
+            note += " — BUDGET EXCEEDED (non-gating)"
+        say(note)
 
     if emit_json:
         print(json.dumps({

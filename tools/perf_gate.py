@@ -46,7 +46,7 @@ from dmlp_tpu.obs.ledger import build_ledger, series_deltas  # noqa: E402
 GATED_PREFIXES = ("harness/", "bench:", "bench/", "trainbench/", "serve/",
                   "fleet/", "slo/",
                   "train:", "engine:", "roofline:", "capacity:",
-                  "telemetry/", "prune/", "precision/", "auto/")
+                  "telemetry/", "prune/", "precision/", "auto/", "hlo/")
 
 
 def gated(series: str, better: str = "lower") -> bool:

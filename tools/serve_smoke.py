@@ -154,15 +154,22 @@ def main(argv=None) -> int:
         say(f"replay OK: {len(TRACE)} mixed-(nq, k) requests "
             "byte-identical to the golden oracle")
 
-        # 3. compile-once
+        # 3. compile-once — the counter AND the schedule: a recompile
+        # landing on a different program can't hide behind a
+        # coincidentally flat compile_count (obs.hlo fingerprints).
         cli = sc.ServeClient(rdoc["port"])
         stats = cli.stats()["stats"]
         if stats["engine"]["compile_count"] != rdoc["compile_count"]:
             fail(f"compile counter moved {rdoc['compile_count']} -> "
                  f"{stats['engine']['compile_count']}: a request "
                  "recompiled")
+        sched0 = rdoc.get("hlo_schedule", {})
+        if stats["engine"].get("hlo_schedule", {}) != sched0:
+            fail(f"per-bucket HLO schedule changed across the replay: "
+                 f"{sched0} -> {stats['engine'].get('hlo_schedule')}")
         say(f"compile-once OK: counter pinned at "
-            f"{rdoc['compile_count']} across the replay")
+            f"{rdoc['compile_count']} and {len(sched0)} bucket "
+            "fingerprint(s) unchanged across the replay")
 
         # 4. live scrape
         http_port = None
@@ -224,8 +231,11 @@ def main(argv=None) -> int:
         stats = cli.stats()["stats"]
         if stats["engine"]["compile_count"] != rdoc["compile_count"]:
             fail("ingestion recompiled a solve program")
+        if stats["engine"].get("hlo_schedule", {}) != sched0:
+            fail("ingestion changed a bucket's compiled-program "
+                 "fingerprint")
         say("ingestion OK: grown-corpus replay golden-identical, "
-            "zero new solve compiles")
+            "zero new solve compiles, schedule fingerprints unchanged")
         cli.close()
 
         # 7. graceful drain
